@@ -1,0 +1,425 @@
+"""Level-13 cell coverings of footprints, with the reference's semantics.
+
+Mirrors the behavior of /root/reference/pkg/geo/s2.go and
+pkg/models/geo.go:
+
+  - coverings are computed at the fixed DAR level 13 (s2.go:16-25);
+  - the area limit is 2500 "km^2" computed with the reference's exact
+    formula  loop_area_km2 = steradians * 510072000 / 4 * pi
+    (s2.go:89-95 — note the formula multiplies rather than divides by
+    pi; we reproduce it verbatim for parity);
+  - if the loop exceeds the limit the vertex order is reversed once and
+    retried (winding-order auto-fix, s2.go:100-110);
+  - a degenerate (zero-area) loop falls back to covering the polyline
+    of its vertices (s2.go:116-120);
+  - circles are covered via an inscribed 20-vertex regular loop
+    (pkg/models/geo.go:224-239);
+  - "area" strings are 'lat0,lon0,lat1,lon1,...' (s2.go:124-166).
+
+The covering itself is the set of level-13 cells that intersect the
+region — the same set an S2 RegionCoverer with MinLevel=MaxLevel=13
+produces — computed by a seeded BFS flood fill over the level-13 grid
+with spherical cell/loop intersection tests.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from dss_tpu.geo import s2cell
+from dss_tpu.geo.s2cell import (
+    DAR_LEVEL,
+    cell_corners,
+    cell_id_from_point,
+    cell_level,
+    cell_neighbors8,
+    latlng_to_xyz,
+    st_to_uv,
+    uv_to_st,
+    xyz_to_face_uv,
+)
+
+MAX_AREA_KM2 = 2500.0
+EARTH_AREA_KM2 = 510072000.0
+RADIUS_EARTH_METER = 6371010.0
+# Safety valve: densest legal covering is ~MAX_AREA cells plus boundary.
+_MAX_COVERING_CELLS = 100_000
+
+
+class AreaTooLargeError(Exception):
+    """Requested area exceeds MAX_AREA_KM2 (maps to HTTP 413)."""
+
+
+class BadAreaError(Exception):
+    """Coordinates did not create a well-formed area."""
+
+
+# ---------------------------------------------------------------------------
+# Spherical predicates (double precision)
+# ---------------------------------------------------------------------------
+
+
+def _sign(a, b, c):
+    """Sign of det(a, b, c): +1 if c is left of a->b (CCW), else -1/0."""
+    d = np.dot(np.cross(a, b), c)
+    if d > 0:
+        return 1
+    if d < 0:
+        return -1
+    return 0
+
+
+def _ordered_ccw(a, b, c, o):
+    """True if (a, b, c) appear in CCW order as seen around o."""
+    k = 0
+    if _sign(b, o, a) >= 0:
+        k += 1
+    if _sign(c, o, b) >= 0:
+        k += 1
+    if _sign(a, o, c) > 0:
+        k += 1
+    return k >= 2
+
+
+def _same(p, q):
+    return bool(np.all(p == q))
+
+
+def _edges_cross(a, b, c, d):
+    """True if great-circle arcs AB and CD (each < pi) cross at an interior
+    point.  Computes the great-circle intersection and checks it lies
+    strictly within both arcs (robust for long arcs, unlike pure
+    side-of-plane tests)."""
+    n1 = np.cross(a, b)
+    n2 = np.cross(c, d)
+    x = np.cross(n1, n2)
+    norm = np.linalg.norm(x)
+    if norm < 1e-30:
+        return False  # coplanar / degenerate
+    x = x / norm
+    dab = np.dot(a, b)
+    dcd = np.dot(c, d)
+    for s in (1.0, -1.0):
+        p = s * x
+        if (
+            np.dot(p, a) > dab
+            and np.dot(p, b) > dab
+            and np.dot(p, c) > dcd
+            and np.dot(p, d) > dcd
+        ):
+            return True
+    return False
+
+
+def _vertex_crossing(a, b, c, d):
+    """S2 VertexCrossing semantics for arcs sharing an endpoint: defines a
+    consistent parity so a path through a shared vertex counts once."""
+    if _same(a, b) or _same(c, d):
+        return False
+    if _same(a, d):
+        return _ordered_ccw(_ortho(a), c, b, a)
+    if _same(b, c):
+        return _ordered_ccw(_ortho(b), d, a, b)
+    if _same(a, c):
+        return _ordered_ccw(_ortho(a), d, b, a)
+    if _same(b, d):
+        return _ordered_ccw(_ortho(b), c, a, b)
+    return False
+
+
+def _edge_or_vertex_crossing(a, b, c, d):
+    if _same(a, c) or _same(a, d) or _same(b, c) or _same(b, d):
+        return _vertex_crossing(a, b, c, d)
+    return _edges_cross(a, b, c, d)
+
+
+def _ortho(p):
+    """A unit vector orthogonal to p."""
+    k = int(np.argmin(np.abs(p)))
+    axis = np.zeros(3)
+    axis[k] = 1.0
+    o = np.cross(p, axis)
+    return o / np.linalg.norm(o)
+
+
+class Loop:
+    """A closed spherical loop; the interior is on the left of the edges.
+
+    Implements containment via edge-crossing parity from a fixed origin
+    point, with the origin's own containment bootstrapped from the
+    vertex-1 interior-angle test (the standard S2 construction).
+    """
+
+    def __init__(self, vertices_xyz):
+        v = np.asarray(vertices_xyz, dtype=np.float64)
+        if v.ndim != 2 or v.shape[-1] != 3:
+            raise ValueError("vertices must be (N, 3)")
+        self.v = v
+        self.n = len(v)
+        self._origin = np.array([-0.0099994664, 0.0025924542, 0.9999466])
+        self._origin /= np.linalg.norm(self._origin)
+        if self.n >= 3:
+            v1_inside = _ordered_ccw(
+                _ortho(self.v[1]), self.v[0], self.v[2], self.v[1]
+            )
+            contains_v1 = self._contains_assuming_origin_outside(self.v[1])
+            self._origin_inside = v1_inside != contains_v1
+        else:
+            self._origin_inside = False
+
+    def _crossing_parity(self, p):
+        """Number of loop edges crossed by segment origin->p, mod 2
+        (edge-or-vertex crossing semantics)."""
+        crossings = 0
+        o = self._origin
+        for k in range(self.n):
+            a = self.v[k]
+            b = self.v[(k + 1) % self.n]
+            if _edge_or_vertex_crossing(o, p, a, b):
+                crossings ^= 1
+        return crossings
+
+    def _contains_assuming_origin_outside(self, p):
+        return self._crossing_parity(p) == 1
+
+    def contains(self, p):
+        """True if unit point p is inside the loop interior."""
+        return self._origin_inside != (self._crossing_parity(p) == 1)
+
+    def signed_area(self):
+        """Signed spherical area (steradians); positive for CCW loops."""
+        if self.n < 3:
+            return 0.0
+        total = 0.0
+        v0 = self.v[0]
+        for k in range(1, self.n - 1):
+            a, b, c = v0, self.v[k], self.v[k + 1]
+            triple = np.dot(np.cross(a, b), c)
+            denom = 1.0 + np.dot(a, b) + np.dot(b, c) + np.dot(c, a)
+            total += 2.0 * math.atan2(triple, denom)
+        return total
+
+    def area(self):
+        """Interior area in steradians (interior = left of edges), [0, 4pi]."""
+        s = self.signed_area()
+        return s if s >= 0 else 4.0 * math.pi + s
+
+
+def loop_area_km2(loop: Loop) -> float:
+    """The reference's loop-area formula, reproduced exactly.
+
+    pkg/geo/s2.go:89-95:  (area_sr * 510072000) / 4 * pi
+    (multiplies by pi — the reference's quirk is part of the contract:
+    it determines which areas pass the 2500 'km^2' validation gate).
+    """
+    if loop.n == 0:
+        return 0.0
+    return (loop.area() * EARTH_AREA_KM2) / 4.0 * math.pi
+
+
+# ---------------------------------------------------------------------------
+# Cell / loop intersection
+# ---------------------------------------------------------------------------
+
+
+def _point_in_cell(p, face, u_lo, u_hi, v_lo, v_hi):
+    """True if unit point p lies within the given face-uv rectangle."""
+    pf, pu, pv = xyz_to_face_uv(p)
+    if int(pf) == int(face):
+        return u_lo <= pu <= u_hi and v_lo <= pv <= v_hi
+    # p may project onto the cell across a face boundary only at the exact
+    # edge; treat different-face points as outside (BFS neighbors cover
+    # the adjacent face's cells anyway).
+    return False
+
+
+def _cell_intersects_loop(cell_id, loop: Loop, loop_vertex_cells) -> bool:
+    """Conservative-exact test: does the level-13 cell intersect the loop?
+
+    True iff (a) any cell corner is inside the loop, (b) any loop vertex
+    lies in the cell, or (c) any loop edge crosses any cell edge.
+    """
+    corners = cell_corners(cell_id)  # (4, 3)
+    for k in range(4):
+        if loop.contains(corners[k]):
+            return True
+    if int(np.uint64(cell_id)) in loop_vertex_cells:
+        return True
+    face, u_lo, u_hi, v_lo, v_hi = s2cell.cell_uv_bounds(cell_id)
+    for k in range(loop.n):
+        if _point_in_cell(loop.v[k], face, u_lo, u_hi, v_lo, v_hi):
+            return True
+    for k in range(loop.n):
+        a = loop.v[k]
+        b = loop.v[(k + 1) % loop.n]
+        for e in range(4):
+            c = corners[e]
+            d = corners[(e + 1) % 4]
+            if _edges_cross(a, b, c, d):
+                return True
+    return False
+
+
+def _segment_intersects_cell(a, b, cell_id) -> bool:
+    corners = cell_corners(cell_id)
+    face, u_lo, u_hi, v_lo, v_hi = s2cell.cell_uv_bounds(cell_id)
+    if _point_in_cell(a, face, u_lo, u_hi, v_lo, v_hi):
+        return True
+    if _point_in_cell(b, face, u_lo, u_hi, v_lo, v_hi):
+        return True
+    for e in range(4):
+        c = corners[e]
+        d = corners[(e + 1) % 4]
+        if _edges_cross(a, b, c, d):
+            return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# Coverings
+# ---------------------------------------------------------------------------
+
+
+def _flood_fill(seeds, predicate):
+    """BFS over the level-13 grid from seed cells, keeping cells where
+    predicate(cell) holds; returns a sorted uint64 array."""
+    result = set()
+    frontier = []
+    seen = set()
+    for s in seeds:
+        si = int(np.uint64(s))
+        if si not in seen:
+            seen.add(si)
+            frontier.append(np.uint64(s))
+    while frontier:
+        cid = frontier.pop()
+        if predicate(cid):
+            result.add(int(np.uint64(cid)))
+            if len(result) > _MAX_COVERING_CELLS:
+                raise AreaTooLargeError("covering exceeds maximum cell count")
+            for nb in cell_neighbors8(cid):
+                ni = int(np.uint64(nb))
+                if ni not in seen:
+                    seen.add(ni)
+                    frontier.append(nb)
+    return np.sort(np.array(sorted(result), dtype=np.uint64))
+
+
+def covering_polyline(points_xyz) -> np.ndarray:
+    """Level-13 cells intersecting the polyline through the given points."""
+    pts = np.asarray(points_xyz, dtype=np.float64)
+    if len(pts) == 0:
+        return np.array([], dtype=np.uint64)
+    result = set()
+    for k in range(max(1, len(pts) - 1)):
+        a = pts[k]
+        b = pts[min(k + 1, len(pts) - 1)]
+        seeds = [
+            cell_id_from_point(a, level=DAR_LEVEL),
+            cell_id_from_point(b, level=DAR_LEVEL),
+        ]
+        cells = _flood_fill(seeds, lambda cid: _segment_intersects_cell(a, b, cid))
+        result.update(int(c) for c in cells)
+    return np.sort(np.array(sorted(result), dtype=np.uint64))
+
+
+def _loop_covering(loop: Loop) -> np.ndarray:
+    loop_vertex_cells = {
+        int(np.uint64(cell_id_from_point(loop.v[k], level=DAR_LEVEL)))
+        for k in range(loop.n)
+    }
+    seeds = [np.uint64(c) for c in loop_vertex_cells]
+    return _flood_fill(
+        seeds, lambda cid: _cell_intersects_loop(cid, loop, loop_vertex_cells)
+    )
+
+
+def covering_from_loop_points(points_xyz) -> np.ndarray:
+    """Covering of the loop through the given points, with the reference's
+    winding-retry / area-limit / polyline-fallback semantics
+    (pkg/geo/s2.go:97-122)."""
+    pts = list(np.asarray(points_xyz, dtype=np.float64))
+    loop = Loop(np.asarray(pts))
+    area = loop_area_km2(loop)
+    if area > MAX_AREA_KM2:
+        pts.reverse()
+        loop = Loop(np.asarray(pts))
+    area = loop_area_km2(loop)
+    if area > MAX_AREA_KM2:
+        raise AreaTooLargeError(
+            f"area is too large ({area:f}km² > {MAX_AREA_KM2:f}km²)"
+        )
+    if area <= 0:
+        return covering_polyline(np.asarray(pts))
+    return _loop_covering(loop)
+
+
+def covering_polygon(vertices_latlng) -> np.ndarray:
+    """Covering of a lat/lng polygon (list of (lat, lng) degrees).
+
+    Validation per pkg/models/geo.go:252-268.
+    """
+    pts = []
+    for lat, lng in vertices_latlng:
+        if lat > 90.0 or lat < -90.0 or lng > 180.0 or lng < -180.0:
+            raise BadAreaError("coordinates did not create a well formed area")
+        pts.append(latlng_to_xyz(lat, lng))
+    if len(pts) < 3:
+        raise BadAreaError("not enough points in polygon")
+    return covering_from_loop_points(np.asarray(pts))
+
+
+def covering_circle(lat, lng, radius_meter) -> np.ndarray:
+    """Covering of a circle via an inscribed 20-vertex regular loop
+    (pkg/models/geo.go:224-239)."""
+    if lat > 90.0 or lat < -90.0 or lng > 180.0 or lng < -180.0:
+        raise BadAreaError("coordinates did not create a well formed area")
+    if not radius_meter > 0:
+        raise BadAreaError("radius must be larger than 0")
+    center = latlng_to_xyz(lat, lng)
+    radius_angle = radius_meter / RADIUS_EARTH_METER
+    # regular loop: 20 vertices CCW around center at the given angular radius
+    z = center
+    x = _ortho(z)
+    y = np.cross(z, x)
+    y /= np.linalg.norm(y)
+    cos_r = math.cos(radius_angle)
+    sin_r = math.sin(radius_angle)
+    pts = []
+    for k in range(20):
+        theta = 2.0 * math.pi * k / 20.0
+        p = cos_r * z + sin_r * (math.cos(theta) * x + math.sin(theta) * y)
+        pts.append(p / np.linalg.norm(p))
+    loop = Loop(np.asarray(pts))
+    if loop_area_km2(loop) <= 0:
+        return covering_polyline(np.asarray(pts))
+    return _loop_covering(loop)
+
+
+def area_to_cell_ids(area: str) -> np.ndarray:
+    """Parse 'lat0,lng0,lat1,lng1,...' and return its covering
+    (pkg/geo/s2.go:124-166)."""
+    parts = area.split(",") if area else []
+    if len(parts) % 2 == 1:
+        raise BadAreaError("odd number of coordinates in area string")
+    if len(parts) // 2 < 3:
+        raise BadAreaError("not enough points in polygon")
+    coords = []
+    for raw in parts:
+        try:
+            coords.append(float(raw.strip()))
+        except ValueError:
+            raise BadAreaError("coordinates did not create a well formed area")
+    pts = [
+        latlng_to_xyz(coords[k], coords[k + 1]) for k in range(0, len(coords), 2)
+    ]
+    return covering_from_loop_points(np.asarray(pts))
+
+
+def validate_cell(cell_id) -> None:
+    """Cells handled by the DAR must be at level 13 (pkg/geo/s2.go:50-55)."""
+    lvl = int(cell_level(cell_id))
+    if lvl != DAR_LEVEL:
+        raise BadAreaError("cells must be at level 13 at current implementation")

@@ -1,0 +1,146 @@
+"""Tests for level-13 coverings (semantics per reference pkg/geo/s2.go)."""
+
+import numpy as np
+import pytest
+
+from dss_tpu.geo import covering, s2cell
+from dss_tpu.geo.covering import (
+    AreaTooLargeError,
+    BadAreaError,
+    Loop,
+    area_to_cell_ids,
+    covering_circle,
+    covering_polygon,
+    loop_area_km2,
+)
+
+
+def square(lat, lng, half_deg):
+    return [
+        (lat - half_deg, lng - half_deg),
+        (lat - half_deg, lng + half_deg),
+        (lat + half_deg, lng + half_deg),
+        (lat + half_deg, lng - half_deg),
+    ]
+
+
+def test_loop_area_small_square():
+    # 0.1 x 0.1 degree square at the equator: ~123.6 true km^2
+    pts = np.asarray(
+        [s2cell.latlng_to_xyz(la, ln) for la, ln in square(0.0, 0.0, 0.05)]
+    )
+    loop = Loop(pts)
+    true_km2 = loop.area() * 6371.010**2
+    assert 110 < true_km2 < 140
+    # the reference formula multiplies by pi (quirk reproduced exactly)
+    assert abs(loop_area_km2(loop) - loop.area() * 510072000.0 / 4.0 * np.pi) < 1e-9
+
+
+def test_loop_contains_centroid():
+    pts = np.asarray(
+        [s2cell.latlng_to_xyz(la, ln) for la, ln in square(10.0, 20.0, 0.05)]
+    )
+    loop = Loop(pts)
+    assert loop.contains(s2cell.latlng_to_xyz(10.0, 20.0))
+    assert not loop.contains(s2cell.latlng_to_xyz(11.0, 20.0))
+    assert not loop.contains(s2cell.latlng_to_xyz(-10.0, -160.0))
+
+
+def test_covering_basic_square():
+    cells = covering_polygon(square(37.0, -122.0, 0.05))
+    assert len(cells) > 0
+    levels = s2cell.cell_level(cells)
+    assert np.all(levels == 13)
+    # centroid's cell must be in the covering
+    c = s2cell.cell_id_from_latlng(37.0, -122.0, level=13)
+    assert int(c) in {int(x) for x in cells}
+    # covering is sorted and unique
+    assert np.all(np.diff(cells.astype(np.uint64)) > 0)
+
+
+def test_covering_conservative_vs_sampling():
+    """Every sampled interior point's cell must appear in the covering."""
+    verts = square(47.6, -122.3, 0.04)
+    cells = {int(x) for x in covering_polygon(verts)}
+    lats = np.linspace(47.6 - 0.039, 47.6 + 0.039, 40)
+    lngs = np.linspace(-122.3 - 0.039, -122.3 + 0.039, 40)
+    for la in lats:
+        for ln in lngs:
+            cid = int(s2cell.cell_id_from_latlng(la, ln, level=13))
+            assert cid in cells, (la, ln)
+
+
+def test_covering_winding_invariant():
+    ccw = covering_polygon(square(1.0, 2.0, 0.05))
+    cw = covering_polygon(list(reversed(square(1.0, 2.0, 0.05))))
+    np.testing.assert_array_equal(ccw, cw)
+
+
+def test_covering_too_large():
+    with pytest.raises(AreaTooLargeError):
+        covering_polygon(square(0.0, 0.0, 0.5))
+
+
+def test_covering_degenerate_polyline_fallback():
+    # collinear points -> zero-area loop -> polyline covering
+    cells = covering_polygon([(0.0, 0.0), (0.0, 0.02), (0.0, 0.04)])
+    assert len(cells) > 0
+    assert np.all(s2cell.cell_level(cells) == 13)
+    # covers the cells along the segment
+    assert int(s2cell.cell_id_from_latlng(0.0, 0.02, level=13)) in {
+        int(x) for x in cells
+    }
+
+
+def test_covering_polygon_validation():
+    with pytest.raises(BadAreaError):
+        covering_polygon([(91.0, 0.0), (0.0, 1.0), (1.0, 1.0)])
+    with pytest.raises(BadAreaError):
+        covering_polygon([(0.0, 0.0), (0.0, 1.0)])
+
+
+def test_area_string_parsing():
+    cells = area_to_cell_ids("37.0,-122.0,37.05,-122.0,37.05,-122.05,37.0,-122.05")
+    assert len(cells) > 0
+    with pytest.raises(BadAreaError):
+        area_to_cell_ids("37.0,-122.0,37.05")  # odd number of coords
+    with pytest.raises(BadAreaError):
+        area_to_cell_ids("37.0,-122.0,37.05,-122.0")  # < 3 points
+    with pytest.raises(BadAreaError):
+        area_to_cell_ids("37.0,-122.0,37.05,-122.0,bogus,-122.05")
+
+
+def test_circle_covering():
+    cells = covering_circle(52.5, 13.4, 2000.0)
+    assert len(cells) > 0
+    assert int(s2cell.cell_id_from_latlng(52.5, 13.4, level=13)) in {
+        int(x) for x in cells
+    }
+    with pytest.raises(BadAreaError):
+        covering_circle(52.5, 13.4, 0.0)
+    with pytest.raises(BadAreaError):
+        covering_circle(95.0, 13.4, 100.0)
+
+
+def test_circle_covering_conservative():
+    # points within the circle radius must land in covered cells
+    cells = {int(x) for x in covering_circle(10.0, 10.0, 3000.0)}
+    rng = np.random.default_rng(7)
+    for _ in range(100):
+        # sample points well inside the inscribed 20-gon (radius * cos(pi/20))
+        r = rng.uniform(0, 2800.0 * 0.987)
+        theta = rng.uniform(0, 2 * np.pi)
+        dlat = (r / 6371010.0) * np.cos(theta) * 180.0 / np.pi
+        dlng = (r / 6371010.0) * np.sin(theta) * 180.0 / np.pi / np.cos(
+            np.deg2rad(10.0)
+        )
+        cid = int(s2cell.cell_id_from_latlng(10.0 + dlat, 10.0 + dlng, level=13))
+        assert cid in cells
+
+
+def test_validate_cell():
+    c13 = s2cell.cell_id_from_latlng(0.0, 0.0, level=13)
+    covering.validate_cell(c13)
+    c12 = s2cell.cell_parent(c13, 12)
+    with pytest.raises(BadAreaError):
+        covering.validate_cell(c12)

@@ -1,0 +1,153 @@
+"""Unit tests for the S2 cell-id math."""
+
+import numpy as np
+import pytest
+
+from dss_tpu.geo import s2cell
+
+
+def test_st_uv_roundtrip():
+    s = np.linspace(0.0, 1.0, 101)
+    np.testing.assert_allclose(s2cell.uv_to_st(s2cell.st_to_uv(s)), s, atol=1e-12)
+    u = np.linspace(-1.0, 1.0, 101)
+    np.testing.assert_allclose(s2cell.st_to_uv(s2cell.uv_to_st(u)), u, atol=1e-12)
+
+
+def test_latlng_xyz_roundtrip():
+    rng = np.random.default_rng(0)
+    lat = rng.uniform(-89, 89, 100)
+    lng = rng.uniform(-179, 179, 100)
+    p = s2cell.latlng_to_xyz(lat, lng)
+    np.testing.assert_allclose(np.linalg.norm(p, axis=-1), 1.0, atol=1e-12)
+    lat2, lng2 = s2cell.xyz_to_latlng(p)
+    np.testing.assert_allclose(lat2, lat, atol=1e-9)
+    np.testing.assert_allclose(lng2, lng, atol=1e-9)
+
+
+def test_face_uv_roundtrip():
+    rng = np.random.default_rng(1)
+    p = rng.normal(size=(200, 3))
+    p /= np.linalg.norm(p, axis=-1, keepdims=True)
+    face, u, v = s2cell.xyz_to_face_uv(p)
+    q = s2cell.face_uv_to_xyz(face, u, v)
+    np.testing.assert_allclose(q, p, atol=1e-12)
+    assert np.all(np.abs(u) <= 1.0 + 1e-12)
+    assert np.all(np.abs(v) <= 1.0 + 1e-12)
+
+
+def test_face_ij_roundtrip():
+    rng = np.random.default_rng(2)
+    face = rng.integers(0, 6, 500)
+    i = rng.integers(0, 1 << 30, 500)
+    j = rng.integers(0, 1 << 30, 500)
+    cid = s2cell.from_face_ij(face, i, j)
+    # all leaf ids are odd and have the face in the top 3 bits
+    assert np.all(cid & np.uint64(1) == 1)
+    f2, i2, j2, _ = s2cell.to_face_ij(cid)
+    np.testing.assert_array_equal(f2, face)
+    np.testing.assert_array_equal(i2, i)
+    np.testing.assert_array_equal(j2, j)
+
+
+def test_level_and_parent():
+    cid = s2cell.cell_id_from_latlng(37.0, -122.0)
+    assert int(s2cell.cell_level(cid)) == 30
+    for lvl in (25, 13, 5, 0):
+        parent = s2cell.cell_parent(cid, lvl)
+        assert int(s2cell.cell_level(parent)) == lvl
+        # the parent's leaf range must contain the original leaf
+        lsb = int(s2cell.cell_lsb(parent))
+        lo = int(parent) - lsb + 1
+        hi = int(parent) + lsb - 1
+        assert lo <= int(cid) <= hi
+
+
+def test_point_in_own_cell_bounds():
+    rng = np.random.default_rng(3)
+    lat = rng.uniform(-80, 80, 50)
+    lng = rng.uniform(-179, 179, 50)
+    for la, ln in zip(lat, lng):
+        p = s2cell.latlng_to_xyz(la, ln)
+        cid = s2cell.cell_id_from_point(p, level=13)
+        face, u_lo, u_hi, v_lo, v_hi = s2cell.cell_uv_bounds(cid)
+        pf, pu, pv = s2cell.xyz_to_face_uv(p)
+        assert int(pf) == int(face)
+        assert u_lo - 1e-12 <= pu <= u_hi + 1e-12
+        assert v_lo - 1e-12 <= pv <= v_hi + 1e-12
+
+
+def test_cell_center_maps_back():
+    rng = np.random.default_rng(4)
+    for _ in range(50):
+        la, ln = rng.uniform(-80, 80), rng.uniform(-179, 179)
+        cid = s2cell.cell_id_from_latlng(la, ln, level=13)
+        center = s2cell.cell_center(cid)
+        cid2 = s2cell.cell_id_from_point(center, level=13)
+        assert int(cid2) == int(cid)
+
+
+def test_corners_are_distinct_and_near_center():
+    cid = s2cell.cell_id_from_latlng(47.6, -122.3, level=13)
+    corners = s2cell.cell_corners(cid)
+    assert corners.shape == (4, 3)
+    center = s2cell.cell_center(cid)
+    # level-13 cells are ~1km across: corners within ~2km of center
+    for k in range(4):
+        ang = np.arccos(np.clip(np.dot(corners[k], center), -1, 1))
+        assert 0 < ang < 2000.0 / 6371010.0
+
+
+def test_neighbors_adjacent_and_distinct():
+    cid = s2cell.cell_id_from_latlng(40.7, -74.0, level=13)
+    nbrs = s2cell.cell_neighbors8(cid)
+    assert len(nbrs) == 8
+    assert len({int(n) for n in nbrs}) == 8
+    center = s2cell.cell_center(cid)
+    for nb in nbrs:
+        assert int(s2cell.cell_level(nb)) == 13
+        nc = s2cell.cell_center(nb)
+        ang = np.arccos(np.clip(np.dot(nc, center), -1, 1))
+        # neighbor centers within ~3 cell widths
+        assert ang < 5000.0 / 6371010.0
+
+
+def test_neighbors_wrap_at_face_corner():
+    # cell at a cube-face corner has fewer than 8 distinct neighbors but
+    # the computation must not fail or return itself
+    p = s2cell.face_uv_to_xyz(0, 0.999999999, 0.999999999)
+    cid = s2cell.cell_id_from_point(p, level=13)
+    nbrs = s2cell.cell_neighbors8(cid)
+    assert 3 <= len(nbrs) <= 8
+    assert int(cid) not in {int(n) for n in nbrs}
+
+
+def test_dar_key_roundtrip():
+    rng = np.random.default_rng(5)
+    lat = rng.uniform(-85, 85, 1000)
+    lng = rng.uniform(-180, 180, 1000)
+    cells = s2cell.cell_id_from_latlng(lat, lng, level=13)
+    keys = s2cell.cell_to_dar_key(cells)
+    assert keys.dtype == np.int32
+    assert np.all(keys >= 0)
+    back = s2cell.dar_key_to_cell(keys)
+    np.testing.assert_array_equal(back, cells)
+    # distinct cells -> distinct keys
+    assert len(np.unique(keys)) == len(np.unique(cells))
+
+
+def test_token_roundtrip():
+    cid = s2cell.cell_id_from_latlng(51.5, -0.12, level=13)
+    tok = s2cell.cell_token(cid)
+    assert int(s2cell.cell_from_token(tok)) == int(cid)
+
+
+def test_hilbert_locality():
+    # consecutive cells along the curve at level 13 are spatially adjacent
+    cid = s2cell.cell_id_from_latlng(35.0, 139.0, level=13)
+    lsb = int(s2cell.cell_lsb(cid))
+    nxt = np.uint64(int(cid) + 2 * lsb)
+    if int(s2cell.cell_level(nxt)) == 13:
+        c1 = s2cell.cell_center(cid)
+        c2 = s2cell.cell_center(nxt)
+        ang = np.arccos(np.clip(np.dot(c1, c2), -1, 1))
+        assert ang < 4000.0 / 6371010.0
