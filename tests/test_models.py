@@ -1,0 +1,149 @@
+"""Tests for shared value types and errors."""
+
+from datetime import datetime, timezone
+
+import numpy as np
+import pytest
+
+from dss_tpu import errors
+from dss_tpu.clock import FakeClock, from_nanos, to_nanos
+from dss_tpu.models import core as m
+from dss_tpu.models.volumes import (
+    GeoPolygon,
+    LatLngPoint,
+    Volume3D,
+    Volume4D,
+    union_volumes_4d,
+)
+
+
+def test_version_roundtrip():
+    t = datetime(2026, 7, 1, 12, 30, 15, 123456, tzinfo=timezone.utc)
+    v = m.Version.from_time(t)
+    s = str(v)
+    v2 = m.Version.from_string(s)
+    assert v.matches(v2)
+    assert v2.to_timestamp() == t
+    assert not v.empty
+
+
+def test_version_base32_matches_go_digits():
+    # Go strconv.FormatUint(1000000000, 32) == "tplig0" (digits 0-9a-v)
+    v = m.Version.from_time(from_nanos(1_000_000_000))
+    assert str(v) == "tplig0"
+    assert m.Version.from_string("tplig0").to_timestamp() == from_nanos(
+        1_000_000_000
+    )
+    # spot-check digit set against Go's strconv tables
+    assert str(m.Version.from_time(from_nanos(31))) == "v"
+    assert str(m.Version.from_time(from_nanos(32))) == "10"
+
+
+def test_version_mismatch_and_empty():
+    v1 = m.Version.from_time(datetime(2026, 1, 1, tzinfo=timezone.utc))
+    v2 = m.Version.from_time(datetime(2026, 1, 2, tzinfo=timezone.utc))
+    assert not v1.matches(v2)
+    assert not v1.matches(None)
+    with pytest.raises(ValueError):
+        m.Version.from_string("")
+    with pytest.raises(ValueError):
+        m.Version.from_string("UPPER!")
+
+
+def test_ovn():
+    t = datetime(2026, 7, 1, 10, 0, 0, tzinfo=timezone.utc)
+    ovn = m.new_ovn_from_time(t, "some-id")
+    assert m.ovn_valid(ovn)
+    # deterministic and salt-dependent
+    assert ovn == m.new_ovn_from_time(t, "some-id")
+    assert ovn != m.new_ovn_from_time(t, "other-id")
+    # sub-second times collapse to the same RFC3339 second (Go behavior)
+    t2 = t.replace(microsecond=999999)
+    assert ovn == m.new_ovn_from_time(t2, "some-id")
+
+
+def test_uss_base_url_validation():
+    m.validate_uss_base_url("https://uss.example.com/v1")
+    with pytest.raises(ValueError, match="TLS"):
+        m.validate_uss_base_url("http://uss.example.com")
+    with pytest.raises(ValueError, match="https"):
+        m.validate_uss_base_url("ftp://uss.example.com")
+    with pytest.raises(ValueError):
+        m.validate_uss_base_url("")
+
+
+def test_uuid_validation():
+    m.validate_uuid("4348c8e5-0b1c-43cf-9114-2e67a4532472")
+    with pytest.raises(errors.StatusError):
+        m.validate_uuid("not-a-uuid")
+    with pytest.raises(errors.StatusError):
+        m.validate_uuid("")
+
+
+def test_errors_http_mapping():
+    assert errors.not_found("x").http_status == 404
+    assert errors.bad_request("x").http_status == 400
+    assert errors.already_exists("x").http_status == 409
+    assert errors.version_mismatch("x").http_status == 409
+    assert errors.permission_denied("x").http_status == 403
+    assert errors.exhausted("x").http_status == 429
+    assert errors.unauthenticated("x").http_status == 401
+    assert errors.area_too_large("x").http_status == 413
+    assert errors.missing_ovns([]).http_status == 409
+    assert errors.missing_ovns([]).code == errors.Code.MISSING_OVNS
+
+
+def test_internal_error_obfuscation(monkeypatch):
+    monkeypatch.delenv("DSS_ERRORS_OBFUSCATE_INTERNAL_ERRORS", raising=False)
+    assert errors.internal("secret").message == "Internal Server Error"
+    monkeypatch.setenv("DSS_ERRORS_OBFUSCATE_INTERNAL_ERRORS", "false")
+    assert errors.internal("secret").message == "secret"
+
+
+def test_clock_nanos_roundtrip():
+    t = datetime(2026, 3, 4, 5, 6, 7, 890123, tzinfo=timezone.utc)
+    assert from_nanos(to_nanos(t)) == t
+    fc = FakeClock(t)
+    assert fc.now() == t
+    fc.advance(hours=1)
+    assert fc.now().hour == 6
+
+
+def square_poly(lat, lng, half):
+    return GeoPolygon(
+        vertices=[
+            LatLngPoint(lat - half, lng - half),
+            LatLngPoint(lat - half, lng + half),
+            LatLngPoint(lat + half, lng + half),
+            LatLngPoint(lat + half, lng - half),
+        ]
+    )
+
+
+def test_union_volumes():
+    t1 = datetime(2026, 1, 1, 10, tzinfo=timezone.utc)
+    t2 = datetime(2026, 1, 1, 12, tzinfo=timezone.utc)
+    t3 = datetime(2026, 1, 1, 14, tzinfo=timezone.utc)
+    v1 = Volume4D(
+        spatial_volume=Volume3D(
+            footprint=square_poly(10.0, 20.0, 0.03), altitude_lo=50.0, altitude_hi=100.0
+        ),
+        start_time=t1,
+        end_time=t2,
+    )
+    v2 = Volume4D(
+        spatial_volume=Volume3D(
+            footprint=square_poly(10.05, 20.0, 0.03), altitude_lo=20.0, altitude_hi=80.0
+        ),
+        start_time=t2,
+        end_time=t3,
+    )
+    u = union_volumes_4d([v1, v2])
+    assert u.start_time == t1
+    assert u.end_time == t3
+    assert u.spatial_volume.altitude_lo == 20.0
+    assert u.spatial_volume.altitude_hi == 100.0
+    cells = u.calculate_spatial_covering()
+    c1 = set(int(c) for c in v1.calculate_spatial_covering())
+    c2 = set(int(c) for c in v2.calculate_spatial_covering())
+    assert set(int(c) for c in cells) == c1 | c2
