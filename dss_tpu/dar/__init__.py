@@ -1,0 +1,12 @@
+"""The DAR (DSS Airspace Representation) storage layer.
+
+  snapshot   — DarTable: HBM-resident packed entity/postings arrays with
+               a delta overlay; the device-side replacement for the
+               reference's CockroachDB inverted cell index.
+  oracle     — pure-numpy mirror of the reference's SQL semantics; used
+               for golden tests and as the exact overflow fallback.
+  store      — repository interfaces (the seam from pkg/rid/repos and
+               pkg/scd/store) + the in-memory and DAR-backed stores.
+  wal        — append-only write-ahead log (the CRDB source-of-truth
+               stand-in) with replay.
+"""

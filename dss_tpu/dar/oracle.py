@@ -1,0 +1,84 @@
+"""Pure-numpy oracle for DAR queries.
+
+Mirrors the reference's SQL, literally:
+
+  - conflict/search: DISTINCT entities sharing a cell with the query,
+    then COALESCE'd altitude + time filters and ends_at >= now
+    (pkg/scd/store/cockroach/operations.go:374-435,
+     pkg/rid/cockroach/identification_service_area.go:166-197)
+  - per-owner-per-cell counts (pkg/rid/cockroach/subscriptions.go:86-116)
+
+Used as the golden reference for the JAX kernels and as the exact
+fallback when a device query overflows its fixed result width.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+import numpy as np
+
+
+@dataclass
+class Record:
+    """Host-side row: one live entity version."""
+
+    entity_id: str
+    keys: np.ndarray  # int32 DAR keys, sorted unique
+    alt_lo: float  # -inf if unbounded
+    alt_hi: float  # +inf if unbounded
+    t_start: int  # unix ns
+    t_end: int  # unix ns
+    owner_id: int
+
+
+def search(
+    records: Dict[int, Record],
+    keys: np.ndarray,
+    alt_lo: Optional[float],
+    alt_hi: Optional[float],
+    t_start: Optional[int],
+    t_end: Optional[int],
+    now: int,
+    owner_id: Optional[int] = None,
+):
+    """Slots of records intersecting the query, SQL-COALESCE semantics."""
+    qk = set(int(k) for k in np.asarray(keys).ravel())
+    out = []
+    for slot, r in records.items():
+        if not qk.intersection(int(k) for k in r.keys):
+            continue
+        if alt_lo is not None and not (r.alt_hi >= alt_lo):
+            continue
+        if alt_hi is not None and not (r.alt_lo <= alt_hi):
+            continue
+        if t_start is not None and not (r.t_end >= t_start):
+            continue
+        if t_end is not None and not (r.t_start <= t_end):
+            continue
+        if not (r.t_end >= now):
+            continue
+        if owner_id is not None and r.owner_id != owner_id:
+            continue
+        out.append(slot)
+    return sorted(out)
+
+
+def max_count_per_cell(
+    records: Dict[int, Record],
+    keys: np.ndarray,
+    owner_id: int,
+    now: int,
+) -> int:
+    """Max over query cells of live same-owner entities in that cell."""
+    live = [
+        set(int(k) for k in r.keys)
+        for r in records.values()
+        if r.owner_id == owner_id and r.t_end >= now
+    ]
+    best = 0
+    for k in np.asarray(keys).ravel():
+        ki = int(k)
+        best = max(best, sum(1 for s in live if ki in s))
+    return best

@@ -1,0 +1,409 @@
+"""DarTable: an HBM-resident spatial index for one entity class.
+
+The device-side replacement for the reference's CockroachDB cell index
+(GIN array index for RID, pkg/rid/cockroach/store.go:121-152; join
+tables for SCD, pkg/scd/store/cockroach/store.go:92-151).  One DarTable
+holds one entity class (ISAs, RID subscriptions, SCD operations, SCD
+subscriptions).
+
+Host side keeps the authoritative Record per slot; the device holds the
+packed EntityTable + sorted base Postings + a small sorted delta
+overlay.  Writes are synchronous: a new slot is allocated per entity
+version (append-only; the old slot is tombstoned), its postings go to
+the delta, and the delta is merged into the base when full.  Queries
+run the batched JAX kernel; a result-width overflow falls back to the
+exact numpy oracle.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dss_tpu.dar import oracle
+from dss_tpu.dar.oracle import Record
+from dss_tpu.ops.conflict import (
+    INT32_MAX,
+    NO_TIME_HI,
+    NO_TIME_LO,
+    EntityTable,
+    Postings,
+    QuerySpec,
+    conflict_query_batch,
+    max_count_per_cell as _kernel_max_count,
+)
+
+_QUERY_BUCKETS = (64, 256, 1024, 4096)
+_DELTA_PER_KEY_CAP = 64
+
+
+def _bucket(n: int, buckets=_QUERY_BUCKETS) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    raise ValueError(f"query too wide: {n} cells (max {buckets[-1]})")
+
+
+def _pow2_at_least(n: int, lo: int = 8) -> int:
+    v = lo
+    while v < n:
+        v *= 2
+    return v
+
+
+@jax.jit
+def _set_entity_row(ents: EntityTable, slot, alt_lo, alt_hi, t_start, t_end, active, owner):
+    return EntityTable(
+        alt_lo=ents.alt_lo.at[slot].set(alt_lo),
+        alt_hi=ents.alt_hi.at[slot].set(alt_hi),
+        t_start=ents.t_start.at[slot].set(t_start),
+        t_end=ents.t_end.at[slot].set(t_end),
+        active=ents.active.at[slot].set(active),
+        owner=ents.owner.at[slot].set(owner),
+    )
+
+
+@jax.jit
+def _tombstone_row(ents: EntityTable, slot):
+    return EntityTable(
+        alt_lo=ents.alt_lo,
+        alt_hi=ents.alt_hi,
+        t_start=ents.t_start,
+        t_end=ents.t_end,
+        active=ents.active.at[slot].set(False),
+        owner=ents.owner,
+    )
+
+
+class DarTable:
+    """Thread-safe HBM spatial index for one entity class."""
+
+    def __init__(
+        self,
+        *,
+        max_results: int = 512,
+        delta_capacity: int = 8192,
+        entity_capacity: int = 1024,
+    ):
+        self._lock = threading.RLock()
+        self.max_results = max_results
+        self.delta_capacity = delta_capacity
+
+        # host authoritative state
+        self.records: Dict[int, Record] = {}  # slot -> live record
+        self.slot_of: Dict[str, int] = {}  # entity_id -> live slot
+        self._next_slot = 0
+        self._entity_capacity = entity_capacity
+
+        # host mirrors of postings
+        self._base_key = np.full(0, INT32_MAX, np.int32)
+        self._base_ent = np.full(0, 0, np.int32)
+        self.base_cap = 8
+        self._delta_key = np.full(delta_capacity, INT32_MAX, np.int32)
+        self._delta_ent = np.zeros(delta_capacity, np.int32)
+        self._delta_count = 0
+
+        # device state
+        self._ents = self._empty_entity_table(entity_capacity)
+        self._base = Postings(
+            post_key=jnp.full((8,), INT32_MAX, jnp.int32),
+            post_ent=jnp.full((8,), entity_capacity, jnp.int32),
+        )
+        self._push_delta()
+
+    # -- construction helpers ------------------------------------------------
+
+    def _empty_entity_table(self, capacity: int) -> EntityTable:
+        return EntityTable(
+            alt_lo=jnp.full((capacity + 1,), np.inf, jnp.float32),
+            alt_hi=jnp.full((capacity + 1,), -np.inf, jnp.float32),
+            t_start=jnp.full((capacity + 1,), NO_TIME_HI, jnp.int64),
+            t_end=jnp.full((capacity + 1,), NO_TIME_LO, jnp.int64),
+            active=jnp.zeros((capacity + 1,), jnp.bool_),
+            owner=jnp.full((capacity + 1,), -1, jnp.int32),
+        )
+
+    def _push_delta(self):
+        self._delta = Postings(
+            post_key=jnp.asarray(self._delta_key),
+            post_ent=jnp.asarray(
+                np.where(
+                    self._delta_key == INT32_MAX,
+                    self._entity_capacity,
+                    self._delta_ent,
+                ).astype(np.int32)
+            ),
+        )
+
+    # -- write path ----------------------------------------------------------
+
+    def upsert(
+        self,
+        entity_id: str,
+        keys: np.ndarray,
+        alt_lo: Optional[float],
+        alt_hi: Optional[float],
+        t_start: int,
+        t_end: int,
+        owner_id: int,
+    ) -> None:
+        """Insert or replace an entity. keys are int32 DAR keys."""
+        keys = np.unique(np.asarray(keys, dtype=np.int32))
+        with self._lock:
+            old_slot = self.slot_of.pop(entity_id, None)
+            if old_slot is not None:
+                del self.records[old_slot]
+                self._ents = _tombstone_row(self._ents, old_slot)
+            if (
+                self._next_slot >= self._entity_capacity
+                or self._delta_count + len(keys) > self.delta_capacity
+            ):
+                self._rebuild_locked(
+                    pending=Record(
+                        entity_id=entity_id,
+                        keys=keys,
+                        alt_lo=-np.inf if alt_lo is None else float(alt_lo),
+                        alt_hi=np.inf if alt_hi is None else float(alt_hi),
+                        t_start=int(t_start),
+                        t_end=int(t_end),
+                        owner_id=int(owner_id),
+                    )
+                )
+                return
+            slot = self._next_slot
+            self._next_slot += 1
+            rec = Record(
+                entity_id=entity_id,
+                keys=keys,
+                alt_lo=-np.inf if alt_lo is None else float(alt_lo),
+                alt_hi=np.inf if alt_hi is None else float(alt_hi),
+                t_start=int(t_start),
+                t_end=int(t_end),
+                owner_id=int(owner_id),
+            )
+            self.records[slot] = rec
+            self.slot_of[entity_id] = slot
+            self._ents = _set_entity_row(
+                self._ents,
+                slot,
+                jnp.float32(rec.alt_lo),
+                jnp.float32(rec.alt_hi),
+                jnp.int64(rec.t_start),
+                jnp.int64(rec.t_end),
+                True,
+                jnp.int32(rec.owner_id),
+            )
+            # append postings into the sorted delta
+            n = self._delta_count
+            self._delta_key[n : n + len(keys)] = keys
+            self._delta_ent[n : n + len(keys)] = slot
+            self._delta_count = n + len(keys)
+            order = np.argsort(self._delta_key[: self._delta_count], kind="stable")
+            self._delta_key[: self._delta_count] = self._delta_key[order]
+            self._delta_ent[: self._delta_count] = self._delta_ent[order]
+            # per-key run cap: if exceeded, fold delta into base
+            if self._delta_count:
+                dk = self._delta_key[: self._delta_count]
+                _, counts = np.unique(dk, return_counts=True)
+                if counts.max(initial=0) > _DELTA_PER_KEY_CAP:
+                    self._rebuild_locked()
+                    return
+            self._push_delta()
+
+    def remove(self, entity_id: str) -> bool:
+        with self._lock:
+            slot = self.slot_of.pop(entity_id, None)
+            if slot is None:
+                return False
+            del self.records[slot]
+            self._ents = _tombstone_row(self._ents, slot)
+            return True
+
+    def _rebuild_locked(self, pending: Optional[Record] = None):
+        """Compact slots and rebuild base postings from live records."""
+        live = list(self.records.values())
+        if pending is not None:
+            live.append(pending)
+        need = max(len(live), 1)
+        capacity = _pow2_at_least(need * 2, lo=1024)
+        self._entity_capacity = capacity
+
+        self.records = {}
+        self.slot_of = {}
+        self._next_slot = len(live)
+
+        alt_lo = np.full(capacity + 1, np.inf, np.float32)
+        alt_hi = np.full(capacity + 1, -np.inf, np.float32)
+        t_start = np.full(capacity + 1, NO_TIME_HI, np.int64)
+        t_end = np.full(capacity + 1, NO_TIME_LO, np.int64)
+        active = np.zeros(capacity + 1, np.bool_)
+        owner = np.full(capacity + 1, -1, np.int32)
+
+        total_postings = sum(len(r.keys) for r in live)
+        pk = np.empty(total_postings, np.int32)
+        pe = np.empty(total_postings, np.int32)
+        ofs = 0
+        for slot, rec in enumerate(live):
+            self.records[slot] = rec
+            self.slot_of[rec.entity_id] = slot
+            alt_lo[slot] = rec.alt_lo
+            alt_hi[slot] = rec.alt_hi
+            t_start[slot] = rec.t_start
+            t_end[slot] = rec.t_end
+            active[slot] = True
+            owner[slot] = rec.owner_id
+            pk[ofs : ofs + len(rec.keys)] = rec.keys
+            pe[ofs : ofs + len(rec.keys)] = slot
+            ofs += len(rec.keys)
+        order = np.argsort(pk, kind="stable")
+        pk = pk[order]
+        pe = pe[order]
+        if total_postings:
+            _, counts = np.unique(pk, return_counts=True)
+            self.base_cap = _pow2_at_least(int(counts.max()), lo=8)
+        else:
+            self.base_cap = 8
+        pad = _pow2_at_least(max(total_postings, 8), lo=8)
+        base_key = np.full(pad, INT32_MAX, np.int32)
+        base_ent = np.full(pad, capacity, np.int32)
+        base_key[:total_postings] = pk
+        base_ent[:total_postings] = pe
+        self._base_key = base_key
+        self._base_ent = base_ent
+
+        self._ents = EntityTable(
+            alt_lo=jnp.asarray(alt_lo),
+            alt_hi=jnp.asarray(alt_hi),
+            t_start=jnp.asarray(t_start),
+            t_end=jnp.asarray(t_end),
+            active=jnp.asarray(active),
+            owner=jnp.asarray(owner),
+        )
+        self._base = Postings(
+            post_key=jnp.asarray(base_key), post_ent=jnp.asarray(base_ent)
+        )
+        self._delta_key[:] = INT32_MAX
+        self._delta_ent[:] = 0
+        self._delta_count = 0
+        self._push_delta()
+
+    def rebuild(self):
+        with self._lock:
+            self._rebuild_locked()
+
+    # -- read path -----------------------------------------------------------
+
+    def _pad_keys(self, keys: np.ndarray) -> np.ndarray:
+        keys = np.unique(np.asarray(keys, dtype=np.int32))
+        q = _bucket(max(len(keys), 1))
+        out = np.full(q, -1, np.int32)
+        out[: len(keys)] = keys
+        return out
+
+    def query(
+        self,
+        keys: np.ndarray,
+        alt_lo: Optional[float] = None,
+        alt_hi: Optional[float] = None,
+        t_start: Optional[int] = None,
+        t_end: Optional[int] = None,
+        *,
+        now: int,
+        owner_id: Optional[int] = None,
+    ) -> List[str]:
+        """Entity ids intersecting the query volume (live at/after now)."""
+        with self._lock:
+            if len(np.asarray(keys).ravel()) == 0:
+                return []
+            padded = self._pad_keys(keys)[None, :]
+            spec = QuerySpec(
+                keys=jnp.asarray(padded),
+                alt_lo=jnp.asarray(
+                    [np.float32(-np.inf) if alt_lo is None else np.float32(alt_lo)]
+                ),
+                alt_hi=jnp.asarray(
+                    [np.float32(np.inf) if alt_hi is None else np.float32(alt_hi)]
+                ),
+                t_start=jnp.asarray(
+                    [NO_TIME_LO if t_start is None else np.int64(t_start)]
+                ),
+                t_end=jnp.asarray(
+                    [NO_TIME_HI if t_end is None else np.int64(t_end)]
+                ),
+            )
+            owner_arr = (
+                jnp.asarray([np.int32(owner_id)]) if owner_id is not None else None
+            )
+            slots, overflow = conflict_query_batch(
+                self._base,
+                self._delta,
+                self._ents,
+                spec,
+                jnp.int64(now),
+                owner_arr,
+                base_cap=self.base_cap,
+                delta_cap=_DELTA_PER_KEY_CAP,
+                max_results=self.max_results,
+                with_owner=owner_id is not None,
+            )
+            if bool(overflow[0]):
+                # exact fallback on the host
+                slot_list = oracle.search(
+                    self.records,
+                    np.asarray(keys),
+                    alt_lo,
+                    alt_hi,
+                    t_start,
+                    t_end,
+                    now,
+                    owner_id,
+                )
+            else:
+                arr = np.asarray(slots[0])
+                slot_list = [int(s) for s in arr[arr != INT32_MAX]]
+            out = []
+            for s in slot_list:
+                rec = self.records.get(s)
+                if rec is not None:
+                    out.append(rec.entity_id)
+            return out
+
+    def max_owner_count(self, keys: np.ndarray, owner_id: int, *, now: int) -> int:
+        """DSS0030 quota metric: max per-cell count of live entities owned
+        by owner_id over the query cells."""
+        with self._lock:
+            if len(np.asarray(keys).ravel()) == 0:
+                return 0
+            padded = self._pad_keys(keys)
+            val = _kernel_max_count(
+                self._base,
+                self._delta,
+                self._ents,
+                jnp.asarray(padded),
+                jnp.int64(now),
+                jnp.int32(owner_id),
+                base_cap=self.base_cap,
+                delta_cap=_DELTA_PER_KEY_CAP,
+            )
+            return int(val)
+
+    # -- introspection (bench / graft entry) ----------------------------------
+
+    @property
+    def device_state(self):
+        with self._lock:
+            return self._base, self._delta, self._ents
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "live_records": len(self.records),
+                "entity_capacity": self._entity_capacity,
+                "base_postings": int((self._base_key != INT32_MAX).sum()),
+                "delta_postings": self._delta_count,
+                "base_cap": self.base_cap,
+            }

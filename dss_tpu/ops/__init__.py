@@ -1,0 +1,21 @@
+"""JAX/Pallas kernels for the DAR hot path.
+
+x64 is enabled globally: entity times are exact int64 unix-nanoseconds
+on device, matching the reference's timestamp comparison semantics
+(pkg/scd/store/cockroach/operations.go:374-435).
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+from dss_tpu.ops.conflict import (  # noqa: F401,E402
+    EntityTable,
+    Postings,
+    QuerySpec,
+    conflict_query,
+    conflict_query_batch,
+    max_count_per_cell,
+    NO_TIME_LO,
+    NO_TIME_HI,
+)

@@ -1,0 +1,224 @@
+"""Batched 4D-volume conflict/search kernels over a packed DAR table.
+
+This is the TPU replacement for the reference's per-query CockroachDB
+scans:
+
+  - RID ISA search: SQL `cells && $3` + time window
+    (pkg/rid/cockroach/identification_service_area.go:166-197)
+  - SCD conflict query: DISTINCT entity ids from the cell join table,
+    then altitude + time interval filters
+    (pkg/scd/store/cockroach/operations.go:374-435)
+  - RID per-owner-per-cell subscription quota counts
+    (pkg/rid/cockroach/subscriptions.go:86-116)
+
+Table layout (struct-of-arrays, all static shapes):
+
+  EntityTable — one row per entity *slot*: alt_lo/alt_hi f32[N+1],
+    t_start/t_end i64[N+1] (unix ns), active bool[N+1], owner i32[N+1].
+    Slots are append-only: an update allocates a fresh slot and
+    tombstones the old one (active=False), so postings never need
+    in-place surgery.  Row N is an inactive sentinel that all invalid
+    gathers point to.
+
+  Postings — the inverted cell index: post_key int32[P] sorted
+    ascending (level-13 DAR keys, see dss_tpu.geo.s2cell.cell_to_dar_key;
+    padding INT32_MAX) and post_ent int32[P] (slot per posting, padding
+    points at the sentinel).  A base postings array holds the last
+    rebuild; a small sorted delta overlay holds writes since.
+
+Query algorithm (dense, vmap over the batch):
+  1. two searchsorted calls bound each query cell's postings range,
+  2. gather up to `cap` candidate slots per query cell,
+  3. test altitude/time overlap + active + ends>=now with the SQL's
+     COALESCE semantics (missing bound = pass, encoded as +-inf
+     altitudes and sentinel times),
+  4. dedup by sorting candidate slots, compact to a fixed-width result.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+INT32_MAX = np.int32(2**31 - 1)
+# Sentinel times for "no bound" (comfortably beyond any real timestamp).
+NO_TIME_LO = np.int64(-(2**62))
+NO_TIME_HI = np.int64(2**62)
+NO_OWNER = np.int32(-1)
+
+
+class EntityTable(NamedTuple):
+    alt_lo: jax.Array  # f32[N+1], -inf when unbounded
+    alt_hi: jax.Array  # f32[N+1], +inf when unbounded
+    t_start: jax.Array  # i64[N+1]
+    t_end: jax.Array  # i64[N+1]
+    active: jax.Array  # bool[N+1]
+    owner: jax.Array  # i32[N+1]
+
+
+class Postings(NamedTuple):
+    post_key: jax.Array  # int32[P], sorted ascending, pad INT32_MAX
+    post_ent: jax.Array  # int32[P], slot per posting, pad = sentinel slot
+
+
+class QuerySpec(NamedTuple):
+    """One 4D query (padded); batch axes are added by vmap."""
+
+    keys: jax.Array  # int32[Q], pad -1
+    alt_lo: jax.Array  # f32 scalar, -inf if unbounded
+    alt_hi: jax.Array  # f32 scalar, +inf if unbounded
+    t_start: jax.Array  # i64 scalar, NO_TIME_LO if unbounded
+    t_end: jax.Array  # i64 scalar, NO_TIME_HI if unbounded
+
+
+def _candidates(post: Postings, ents: EntityTable, keys, cap: int):
+    """(ent, cand_valid) of shape (Q, cap): candidate slots per query cell."""
+    n_sentinel = ents.alt_lo.shape[0] - 1
+    p = post.post_key.shape[0]
+    valid_q = keys >= 0
+    lo = jnp.searchsorted(post.post_key, keys, side="left")
+    hi = jnp.searchsorted(post.post_key, keys, side="right")
+    cnt = hi - lo
+    offs = jnp.arange(cap, dtype=lo.dtype)
+    idx = lo[:, None] + offs[None, :]
+    cand_valid = (offs[None, :] < cnt[:, None]) & valid_q[:, None]
+    ent = jnp.where(
+        cand_valid,
+        post.post_ent[jnp.clip(idx, 0, p - 1)],
+        jnp.int32(n_sentinel),
+    )
+    return ent, cand_valid
+
+
+def _attr_test(ents: EntityTable, ent, q: QuerySpec, now, owner_filter):
+    hit = (
+        ents.active[ent]
+        & (ents.alt_hi[ent] >= q.alt_lo)
+        & (ents.alt_lo[ent] <= q.alt_hi)
+        & (ents.t_end[ent] >= q.t_start)
+        & (ents.t_start[ent] <= q.t_end)
+        & (ents.t_end[ent] >= now)
+    )
+    if owner_filter is not None:
+        hit = hit & (ents.owner[ent] == owner_filter)
+    return hit
+
+
+def _compact_unique(ent, hit, max_results: int):
+    """Sort candidate slots, drop duplicates/misses, compact to
+    int32[max_results] (pad INT32_MAX); also return the unique-hit count."""
+    vals = jnp.where(hit, ent, INT32_MAX).ravel()
+    vals = jnp.sort(vals)
+    prev = jnp.concatenate([jnp.full((1,), -1, vals.dtype), vals[:-1]])
+    keep = (vals != prev) & (vals != INT32_MAX)
+    pos = jnp.cumsum(keep) - 1
+    n_unique = jnp.sum(keep)
+    scatter_pos = jnp.where(keep & (pos < max_results), pos, max_results)
+    out = (
+        jnp.zeros((max_results + 1,), jnp.int32)
+        .at[scatter_pos]
+        .set(vals.astype(jnp.int32))[:max_results]
+    )
+    out = jnp.where(
+        jnp.arange(max_results) < jnp.minimum(n_unique, max_results),
+        out,
+        INT32_MAX,
+    )
+    return out, n_unique
+
+
+def conflict_query(
+    base: Postings,
+    delta: Postings,
+    ents: EntityTable,
+    q: QuerySpec,
+    now,
+    *,
+    base_cap: int,
+    delta_cap: int,
+    max_results: int,
+    owner_filter=None,
+):
+    """One query against base + delta postings; returns
+    (slots int32[max_results] padded with INT32_MAX, overflowed bool)."""
+    ent_b, val_b = _candidates(base, ents, q.keys, base_cap)
+    ent_d, val_d = _candidates(delta, ents, q.keys, delta_cap)
+    ent = jnp.concatenate([ent_b.ravel(), ent_d.ravel()])
+    valid = jnp.concatenate([val_b.ravel(), val_d.ravel()])
+    hit = valid & _attr_test(ents, ent, q, now, owner_filter)
+    slots, n_unique = _compact_unique(ent, hit, max_results)
+    return slots, n_unique > max_results
+
+
+@partial(jax.jit, static_argnames=("base_cap", "delta_cap"))
+def max_count_per_cell(
+    base: Postings,
+    delta: Postings,
+    ents: EntityTable,
+    keys,
+    now,
+    owner_filter,
+    *,
+    base_cap: int,
+    delta_cap: int,
+):
+    """Max, over the query cells, of the number of live entities owned by
+    `owner_filter` in that cell (the DSS0030 quota metric).
+
+    Mirrors pkg/rid/cockroach/subscriptions.go:86-116 (COUNT per cell
+    GROUP BY cell, MAX over cells).
+    """
+    q = QuerySpec(
+        keys=keys,
+        alt_lo=jnp.float32(-np.inf),
+        alt_hi=jnp.float32(np.inf),
+        t_start=jnp.int64(NO_TIME_LO),
+        t_end=jnp.int64(NO_TIME_HI),
+    )
+    ent_b, val_b = _candidates(base, ents, keys, base_cap)
+    ent_d, val_d = _candidates(delta, ents, keys, delta_cap)
+    hit_b = val_b & _attr_test(ents, ent_b, q, now, owner_filter)
+    hit_d = val_d & _attr_test(ents, ent_d, q, now, owner_filter)
+    per_cell = jnp.sum(hit_b, axis=1) + jnp.sum(hit_d, axis=1)
+    return jnp.max(per_cell)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("base_cap", "delta_cap", "max_results", "with_owner"),
+)
+def conflict_query_batch(
+    base: Postings,
+    delta: Postings,
+    ents: EntityTable,
+    q: QuerySpec,
+    now,
+    owner_filter=None,
+    *,
+    base_cap: int,
+    delta_cap: int,
+    max_results: int,
+    with_owner: bool = False,
+):
+    """Batched query: QuerySpec fields carry a leading batch axis."""
+
+    def one(qq, ow):
+        return conflict_query(
+            base,
+            delta,
+            ents,
+            qq,
+            now,
+            base_cap=base_cap,
+            delta_cap=delta_cap,
+            max_results=max_results,
+            owner_filter=ow if with_owner else None,
+        )
+
+    if with_owner:
+        return jax.vmap(one)(q, owner_filter)
+    return jax.vmap(one, in_axes=(0, None))(q, jnp.int32(0))

@@ -13,3 +13,11 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The environment's sitecustomize (axon relay) force-rewrites
+# JAX_PLATFORMS to "axon,cpu", which routes every computation through a
+# tunneled remote TPU (~70 ms per host transfer).  Override it at the
+# config level before any backend initialization.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

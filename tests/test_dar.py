@@ -1,0 +1,196 @@
+"""Golden tests: DarTable (JAX kernel) vs the numpy oracle.
+
+The oracle mirrors the reference's SQL (conflict query operations.go:
+374-435, quota counts subscriptions.go:86-116); the kernel must agree
+on randomized workloads including updates, deletes, and delta merges.
+"""
+
+import numpy as np
+import pytest
+
+from dss_tpu.dar import oracle
+from dss_tpu.dar.oracle import Record
+from dss_tpu.dar.snapshot import DarTable
+
+NOW = 1_700_000_000_000_000_000  # ns
+HOUR = 3_600_000_000_000
+
+
+def make_rng_entities(rng, n, key_space=200):
+    ents = []
+    for k in range(n):
+        nkeys = rng.integers(1, 12)
+        keys = rng.choice(key_space, size=nkeys, replace=False).astype(np.int32)
+        alt_lo = float(rng.uniform(0, 500))
+        alt_hi = alt_lo + float(rng.uniform(10, 300))
+        t0 = NOW + int(rng.integers(-5, 10)) * HOUR
+        t1 = t0 + int(rng.integers(1, 8)) * HOUR
+        owner = int(rng.integers(0, 5))
+        ents.append((f"ent-{k}", keys, alt_lo, alt_hi, t0, t1, owner))
+    return ents
+
+
+def fill(table, ents):
+    for eid, keys, alo, ahi, t0, t1, ow in ents:
+        table.upsert(eid, keys, alo, ahi, t0, t1, ow)
+
+
+def oracle_records(ents):
+    return {
+        i: Record(eid, np.unique(keys), alo, ahi, t0, t1, ow)
+        for i, (eid, keys, alo, ahi, t0, t1, ow) in enumerate(ents)
+    }
+
+
+def run_query_both(table, recs, rng, key_space=200, owner=None):
+    nq = rng.integers(1, 30)
+    qkeys = rng.choice(key_space, size=nq, replace=False).astype(np.int32)
+    alt_lo = float(rng.uniform(0, 600)) if rng.random() < 0.7 else None
+    alt_hi = (
+        (alt_lo or 0) + float(rng.uniform(10, 400)) if rng.random() < 0.7 else None
+    )
+    t_start = NOW + int(rng.integers(-3, 6)) * HOUR if rng.random() < 0.7 else None
+    t_end = (
+        (t_start or NOW) + int(rng.integers(1, 6)) * HOUR
+        if rng.random() < 0.7
+        else None
+    )
+    got = table.query(
+        qkeys, alt_lo, alt_hi, t_start, t_end, now=NOW, owner_id=owner
+    )
+    want_slots = oracle.search(
+        recs, qkeys, alt_lo, alt_hi, t_start, t_end, NOW, owner
+    )
+    want = [recs[s].entity_id for s in want_slots]
+    assert sorted(got) == sorted(want), (qkeys, alt_lo, alt_hi, t_start, t_end)
+
+
+def test_kernel_matches_oracle_randomized():
+    rng = np.random.default_rng(42)
+    ents = make_rng_entities(rng, 300)
+    table = DarTable()
+    fill(table, ents)
+    recs = oracle_records(ents)
+    for _ in range(40):
+        run_query_both(table, recs, rng)
+
+
+def test_kernel_matches_oracle_with_owner_filter():
+    rng = np.random.default_rng(43)
+    ents = make_rng_entities(rng, 150)
+    table = DarTable()
+    fill(table, ents)
+    recs = oracle_records(ents)
+    for _ in range(20):
+        run_query_both(table, recs, rng, owner=int(rng.integers(0, 5)))
+
+
+def test_update_replaces_entity():
+    table = DarTable()
+    keys1 = np.array([10, 11, 12], np.int32)
+    keys2 = np.array([50, 51], np.int32)
+    table.upsert("e1", keys1, 0.0, 100.0, NOW, NOW + HOUR, 1)
+    assert table.query(keys1, now=NOW) == ["e1"]
+    # update moves the entity: old cells must stop matching
+    table.upsert("e1", keys2, 0.0, 100.0, NOW, NOW + HOUR, 1)
+    assert table.query(keys1, now=NOW) == []
+    assert table.query(keys2, now=NOW) == ["e1"]
+
+
+def test_delete_tombstones():
+    table = DarTable()
+    keys = np.array([7], np.int32)
+    table.upsert("e1", keys, None, None, NOW, NOW + HOUR, 1)
+    assert table.query(keys, now=NOW) == ["e1"]
+    assert table.remove("e1")
+    assert table.query(keys, now=NOW) == []
+    assert not table.remove("e1")
+
+
+def test_expired_entities_filtered():
+    table = DarTable()
+    keys = np.array([3], np.int32)
+    table.upsert("dead", keys, None, None, NOW - 2 * HOUR, NOW - HOUR, 1)
+    table.upsert("live", keys, None, None, NOW - 2 * HOUR, NOW + HOUR, 1)
+    assert table.query(keys, now=NOW) == ["live"]
+
+
+def test_missing_bounds_coalesce_semantics():
+    table = DarTable()
+    keys = np.array([5], np.int32)
+    # entity with unbounded altitude matches any altitude window
+    table.upsert("e1", keys, None, None, NOW, NOW + HOUR, 1)
+    assert table.query(keys, 10000.0, 20000.0, now=NOW) == ["e1"]
+    # entity with tight altitude; query with no altitude filter matches
+    table.upsert("e2", np.array([6], np.int32), 0.0, 10.0, NOW, NOW + HOUR, 1)
+    assert table.query(np.array([6], np.int32), now=NOW) == ["e2"]
+    # disjoint altitude does not match
+    assert table.query(np.array([6], np.int32), 100.0, 200.0, now=NOW) == []
+
+
+def test_interval_overlap_edges():
+    table = DarTable()
+    keys = np.array([9], np.int32)
+    table.upsert("e", keys, 10.0, 20.0, NOW, NOW + HOUR, 1)
+    # touching boundaries count as overlap (SQL >= / <=)
+    assert table.query(keys, 20.0, 30.0, now=NOW) == ["e"]
+    assert table.query(keys, 0.0, 10.0, now=NOW) == ["e"]
+    assert table.query(keys, None, None, NOW + HOUR, NOW + 2 * HOUR, now=NOW) == ["e"]
+    assert table.query(keys, None, None, NOW - HOUR, NOW, now=NOW) == ["e"]
+    assert table.query(keys, 20.01, 30.0, now=NOW) == []
+
+
+def test_delta_merge_and_growth():
+    """Enough writes to force entity growth and delta->base merges."""
+    rng = np.random.default_rng(44)
+    table = DarTable(delta_capacity=256, entity_capacity=64)
+    ents = make_rng_entities(rng, 500, key_space=100)
+    fill(table, ents)
+    recs = oracle_records(ents)
+    stats = table.stats()
+    assert stats["live_records"] == 500
+    for _ in range(25):
+        run_query_both(table, recs, rng, key_space=100)
+
+
+def test_hot_cell_beyond_delta_cap():
+    """More same-cell writes than the delta per-key cap forces merges and
+    still returns exact results."""
+    table = DarTable()
+    key = np.array([77], np.int32)
+    for k in range(200):
+        table.upsert(f"e{k}", key, None, None, NOW, NOW + HOUR, 1)
+    got = table.query(key, now=NOW)
+    assert len(got) == 200
+
+
+def test_overflow_falls_back_to_oracle():
+    table = DarTable(max_results=16)
+    key = np.array([5], np.int32)
+    for k in range(50):
+        table.upsert(f"e{k}", key, None, None, NOW, NOW + HOUR, 1)
+    got = table.query(key, now=NOW)
+    assert len(got) == 50
+
+
+def test_max_owner_count():
+    rng = np.random.default_rng(45)
+    ents = make_rng_entities(rng, 200, key_space=50)
+    table = DarTable()
+    fill(table, ents)
+    recs = oracle_records(ents)
+    for _ in range(15):
+        nq = rng.integers(1, 10)
+        qkeys = rng.choice(50, size=nq, replace=False).astype(np.int32)
+        owner = int(rng.integers(0, 5))
+        got = table.max_owner_count(qkeys, owner, now=NOW)
+        want = oracle.max_count_per_cell(recs, qkeys, owner, NOW)
+        assert got == want
+
+
+def test_empty_table_and_empty_query():
+    table = DarTable()
+    assert table.query(np.array([1, 2, 3], np.int32), now=NOW) == []
+    assert table.query(np.array([], np.int32), now=NOW) == []
+    table.upsert("e", np.array([1], np.int32), None, None, NOW, NOW + HOUR, 0)
+    assert table.query(np.array([], np.int32), now=NOW) == []
