@@ -1,0 +1,625 @@
+"""The store implementation: host-authoritative state + spatial index + WAL.
+
+One implementation serves both backends — the spatial index strategy is
+injected (`--storage=memory` -> MemorySpatialIndex linear scans,
+`--storage=tpu` -> TpuSpatialIndex HBM DarTable), mirroring how the
+reference selects its store behind the repository seam.
+
+Semantics mirrored from the reference:
+  - RID fenced writes on the commit-timestamp version
+    (pkg/rid/cockroach/identification_service_area.go:97-162)
+  - RID notification fanout = bump live subs intersecting cells
+    (pkg/rid/cockroach/subscriptions.go:204-219)
+  - SCD upsert fencing + OVN key check for Accepted/Activated
+    (pkg/scd/store/cockroach/operations.go:304-372)
+  - SCD delete with implicit-subscription GC
+    (operations.go:239-301)
+  - SCD subscription quota / dependent-op delete block
+    (subscriptions.go:369-495)
+
+Every mutation appends to the WAL after applying; replay rebuilds the
+dicts and the spatial indexes (the HBM snapshot is a cache of the WAL,
+the checkpoint/resume story per SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import threading
+from datetime import datetime, timedelta, timezone
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from dss_tpu import errors
+from dss_tpu.clock import Clock, to_nanos
+from dss_tpu.dar import codec
+from dss_tpu.dar.index import MemorySpatialIndex, TpuSpatialIndex
+from dss_tpu.dar.store import RIDStore, SCDStore
+from dss_tpu.dar.wal import WriteAheadLog
+from dss_tpu.models import rid as ridm
+from dss_tpu.models import scd as scdm
+from dss_tpu.models.core import Version, new_ovn_from_time
+
+MAX_RID_SUBSCRIPTIONS_PER_AREA = 10  # DSS0030
+MAX_SCD_SUBSCRIPTIONS_PER_AREA = 10
+
+
+class TimestampOracle:
+    """Strictly-increasing commit timestamps (microsecond granularity),
+    the stand-in for CRDB's transaction_timestamp()."""
+
+    def __init__(self, clock: Clock):
+        self._clock = clock
+        self._last: Optional[datetime] = None
+        self._lock = threading.Lock()
+
+    def commit_ts(self) -> datetime:
+        with self._lock:
+            now = self._clock.now()
+            if self._last is not None and now <= self._last:
+                now = self._last + timedelta(microseconds=1)
+            self._last = now
+            return now
+
+
+class OwnerInterner:
+    def __init__(self):
+        self._ids: Dict[str, int] = {}
+
+    def intern(self, owner: str) -> int:
+        if owner not in self._ids:
+            self._ids[owner] = len(self._ids)
+        return self._ids[owner]
+
+
+class RIDStoreImpl(RIDStore):
+    def __init__(self, *, clock, ts_oracle, owners, lock, journal, index_factory):
+        self._clock = clock
+        self._ts = ts_oracle
+        self._owners = owners
+        self._lock = lock
+        self._journal = journal
+        self._isas: Dict[str, ridm.IdentificationServiceArea] = {}
+        self._subs: Dict[str, ridm.Subscription] = {}
+        self._isa_index = index_factory()
+        self._sub_index = index_factory()
+
+    @contextlib.contextmanager
+    def transaction(self):
+        with self._lock:
+            yield self
+
+    def _now_ns(self) -> int:
+        return to_nanos(self._clock.now())
+
+    # -- ISAs ----------------------------------------------------------------
+
+    def get_isa(self, id):
+        with self._lock:
+            isa = self._isas.get(id)
+            return dataclasses.replace(isa) if isa else None
+
+    def _index_isa(self, isa):
+        self._isa_index.put(
+            isa.id,
+            isa.cells,
+            isa.altitude_lo,
+            isa.altitude_hi,
+            to_nanos(isa.start_time),
+            to_nanos(isa.end_time),
+            self._owners.intern(isa.owner),
+        )
+
+    def insert_isa(self, isa):
+        with self._lock:
+            old = self._isas.get(isa.id)
+            if isa.version is None or isa.version.empty:
+                if old is not None:
+                    raise errors.internal(
+                        "insert of existing ISA (application precheck bypassed)"
+                    )
+            else:
+                if old is None or not isa.version.matches(old.version):
+                    return None  # fenced write matched no row
+            stored = dataclasses.replace(
+                isa, version=Version.from_time(self._ts.commit_ts())
+            )
+            self._isas[stored.id] = stored
+            self._index_isa(stored)
+            self._journal({"t": "isa_put", "doc": codec.isa_to_doc(stored)})
+            return dataclasses.replace(stored)
+
+    def delete_isa(self, isa):
+        with self._lock:
+            old = self._isas.get(isa.id)
+            if (
+                old is None
+                or old.owner != isa.owner
+                or isa.version is None
+                or not isa.version.matches(old.version)
+            ):
+                return None
+            del self._isas[isa.id]
+            self._isa_index.remove(isa.id)
+            self._journal({"t": "isa_del", "id": isa.id})
+            return dataclasses.replace(old)
+
+    def search_isas(self, cells, earliest, latest):
+        with self._lock:
+            if len(np.asarray(cells).ravel()) == 0:
+                raise errors.bad_request("missing cell IDs for query")
+            if earliest is None:
+                raise errors.internal("must call with an earliest start time.")
+            e_ns = to_nanos(earliest)
+            ids = self._isa_index.query_ids(
+                cells,
+                t_start=e_ns,
+                t_end=None if latest is None else to_nanos(latest),
+                now=e_ns,
+            )
+            return [dataclasses.replace(self._isas[i]) for i in ids if i in self._isas]
+
+    # -- Subscriptions -------------------------------------------------------
+
+    def get_subscription(self, id):
+        with self._lock:
+            sub = self._subs.get(id)
+            return dataclasses.replace(sub) if sub else None
+
+    def _index_sub(self, sub):
+        self._sub_index.put(
+            sub.id,
+            sub.cells,
+            sub.altitude_lo,
+            sub.altitude_hi,
+            to_nanos(sub.start_time),
+            to_nanos(sub.end_time),
+            self._owners.intern(sub.owner),
+        )
+
+    def insert_subscription(self, sub):
+        with self._lock:
+            old = self._subs.get(sub.id)
+            if sub.version is None or sub.version.empty:
+                if old is not None:
+                    raise errors.internal(
+                        "insert of existing subscription (precheck bypassed)"
+                    )
+            else:
+                if old is None or not sub.version.matches(old.version):
+                    return None
+            stored = dataclasses.replace(
+                sub, version=Version.from_time(self._ts.commit_ts())
+            )
+            self._subs[stored.id] = stored
+            self._index_sub(stored)
+            self._journal({"t": "rid_sub_put", "doc": codec.rid_sub_to_doc(stored)})
+            return dataclasses.replace(stored)
+
+    def delete_subscription(self, sub):
+        with self._lock:
+            old = self._subs.get(sub.id)
+            if (
+                old is None
+                or old.owner != sub.owner
+                or sub.version is None
+                or not sub.version.matches(old.version)
+            ):
+                return None
+            del self._subs[sub.id]
+            self._sub_index.remove(sub.id)
+            self._journal({"t": "rid_sub_del", "id": sub.id})
+            return dataclasses.replace(old)
+
+    def search_subscriptions(self, cells):
+        with self._lock:
+            if len(np.asarray(cells).ravel()) == 0:
+                raise errors.bad_request("no location provided")
+            ids = self._sub_index.query_ids(cells, now=self._now_ns())
+            return [dataclasses.replace(self._subs[i]) for i in ids if i in self._subs]
+
+    def search_subscriptions_by_owner(self, cells, owner):
+        with self._lock:
+            if len(np.asarray(cells).ravel()) == 0:
+                raise errors.bad_request("no location provided")
+            ids = self._sub_index.query_ids(
+                cells, now=self._now_ns(), owner_id=self._owners.intern(owner)
+            )
+            return [dataclasses.replace(self._subs[i]) for i in ids if i in self._subs]
+
+    def max_subscription_count_in_cells_by_owner(self, cells, owner):
+        with self._lock:
+            return self._sub_index.max_owner_count(
+                cells, self._owners.intern(owner), now=self._now_ns()
+            )
+
+    def update_notification_idxs_in_cells(self, cells):
+        with self._lock:
+            ids = self._sub_index.query_ids(cells, now=self._now_ns())
+            out = []
+            for i in sorted(ids):
+                sub = self._subs.get(i)
+                if sub is None:
+                    continue
+                sub.notification_index += 1
+                out.append(dataclasses.replace(sub))
+            if out:
+                self._journal({"t": "rid_sub_bump", "ids": [s.id for s in out]})
+            return out
+
+    # -- WAL replay ----------------------------------------------------------
+
+    def apply_wal(self, rec: dict):
+        t = rec["t"]
+        if t == "isa_put":
+            isa = codec.doc_to_isa(rec["doc"])
+            self._isas[isa.id] = isa
+            self._index_isa(isa)
+        elif t == "isa_del":
+            self._isas.pop(rec["id"], None)
+            self._isa_index.remove(rec["id"])
+        elif t == "rid_sub_put":
+            sub = codec.doc_to_rid_sub(rec["doc"])
+            self._subs[sub.id] = sub
+            self._index_sub(sub)
+        elif t == "rid_sub_del":
+            self._subs.pop(rec["id"], None)
+            self._sub_index.remove(rec["id"])
+        elif t == "rid_sub_bump":
+            for i in rec["ids"]:
+                if i in self._subs:
+                    self._subs[i].notification_index += 1
+
+
+class SCDStoreImpl(SCDStore):
+    def __init__(self, *, clock, ts_oracle, owners, lock, journal, index_factory):
+        self._clock = clock
+        self._ts = ts_oracle
+        self._owners = owners
+        self._lock = lock
+        self._journal = journal
+        self._ops: Dict[str, scdm.Operation] = {}
+        self._subs: Dict[str, scdm.Subscription] = {}
+        self._op_index = index_factory()
+        self._sub_index = index_factory()
+
+    @contextlib.contextmanager
+    def transaction(self):
+        with self._lock:
+            yield self
+
+    def _now_ns(self) -> int:
+        return to_nanos(self._clock.now())
+
+    def _visible_op(self, id) -> Optional[scdm.Operation]:
+        """Expired operations are invisible (operations.go:103-112)."""
+        op = self._ops.get(id)
+        if op is None or to_nanos(op.end_time) < self._now_ns():
+            return None
+        return op
+
+    def _visible_sub(self, id) -> Optional[scdm.Subscription]:
+        sub = self._subs.get(id)
+        if sub is None or to_nanos(sub.end_time) < self._now_ns():
+            return None
+        return sub
+
+    # -- Operations ----------------------------------------------------------
+
+    def get_operation(self, id):
+        with self._lock:
+            op = self._visible_op(id)
+            if op is None:
+                raise errors.not_found(id)
+            return dataclasses.replace(op)
+
+    def _index_op(self, op):
+        self._op_index.put(
+            op.id,
+            op.cells,
+            op.altitude_lower,
+            op.altitude_upper,
+            to_nanos(op.start_time),
+            to_nanos(op.end_time),
+            self._owners.intern(op.owner),
+        )
+
+    def _index_scd_sub(self, sub):
+        self._sub_index.put(
+            sub.id,
+            sub.cells,
+            sub.altitude_lo,
+            sub.altitude_hi,
+            to_nanos(sub.start_time),
+            to_nanos(sub.end_time),
+            self._owners.intern(sub.owner),
+        )
+
+    def _search_ops_locked(self, cells, alt_lo, alt_hi, earliest, latest):
+        ids = self._op_index.query_ids(
+            cells,
+            alt_lo=alt_lo,
+            alt_hi=alt_hi,
+            t_start=None if earliest is None else to_nanos(earliest),
+            t_end=None if latest is None else to_nanos(latest),
+            now=self._now_ns(),
+        )
+        return [dataclasses.replace(self._ops[i]) for i in sorted(ids) if i in self._ops]
+
+    def search_operations(self, cells, alt_lo, alt_hi, earliest, latest):
+        with self._lock:
+            if len(np.asarray(cells).ravel()) == 0:
+                raise errors.bad_request("missing cell IDs for query")
+            return self._search_ops_locked(cells, alt_lo, alt_hi, earliest, latest)
+
+    def _notify_subs_locked(self, cells) -> List[scdm.Subscription]:
+        """Bump + return live subscriptions intersecting cells
+        (subscriptions.go:128-173)."""
+        ids = self._sub_index.query_ids(cells, now=self._now_ns())
+        out = []
+        for i in sorted(ids):
+            sub = self._subs.get(i)
+            if sub is None:
+                continue
+            sub.notification_index += 1
+            out.append(dataclasses.replace(sub))
+        if out:
+            self._journal({"t": "scd_sub_bump", "ids": [s.id for s in out]})
+        return out
+
+    def upsert_operation(self, op, key):
+        with self._lock:
+            old = self._visible_op(op.id)
+            if old is None and op.version != 0:
+                raise errors.not_found(op.id)
+            if old is not None and op.version == 0:
+                raise errors.already_exists(op.id)
+            if old is not None and op.version != old.version:
+                raise errors.version_mismatch("old version")
+            if old is not None and old.owner != op.owner:
+                raise errors.permission_denied(
+                    f"Operation is owned by {old.owner}"
+                )
+            op.validate_time_range()
+
+            if op.state in scdm.OperationState.REQUIRES_KEY:
+                conflicting = self._search_ops_locked(
+                    op.cells,
+                    op.altitude_lower,
+                    op.altitude_upper,
+                    op.start_time,
+                    op.end_time,
+                )
+                key_set = set(key)
+                missing = [c for c in conflicting if c.ovn not in key_set]
+                if missing:
+                    raise errors.missing_ovns(missing)
+
+            ts = self._ts.commit_ts()
+            stored = dataclasses.replace(
+                op,
+                version=(old.version if old else 0) + 1,
+                ovn=new_ovn_from_time(ts, op.id),
+            )
+            self._ops[stored.id] = stored
+            self._index_op(stored)
+            self._journal({"t": "scd_op_put", "doc": codec.op_to_doc(stored)})
+            subs = self._notify_subs_locked(stored.cells)
+            return dataclasses.replace(stored), subs
+
+    def delete_operation(self, id, owner):
+        with self._lock:
+            old = self._visible_op(id)
+            if old is None:
+                raise errors.not_found(id)
+            if old.owner != owner:
+                raise errors.permission_denied(f"Operation is owned by {old.owner}")
+            subs = self._notify_subs_locked(old.cells)
+            del self._ops[id]
+            self._op_index.remove(id)
+            self._journal({"t": "scd_op_del", "id": id})
+            # implicit-subscription GC (operations.go:249-267,296-298)
+            sub = self._subs.get(old.subscription_id)
+            if (
+                sub is not None
+                and sub.implicit_subscription
+                and sub.owner == owner
+                and not any(
+                    o.subscription_id == sub.id for o in self._ops.values()
+                )
+            ):
+                del self._subs[sub.id]
+                self._sub_index.remove(sub.id)
+                self._journal({"t": "scd_sub_del", "id": sub.id})
+            return dataclasses.replace(old), subs
+
+    # -- Subscriptions -------------------------------------------------------
+
+    def _dependent_ops_locked(self, sub) -> List[str]:
+        """The reference populates DependentOperations with the ids of
+        operations intersecting the subscription's own 4D volume
+        (subscriptions.go:212-249)."""
+        if len(np.asarray(sub.cells).ravel()) == 0:
+            return []
+        ops = self._search_ops_locked(
+            sub.cells, sub.altitude_lo, sub.altitude_hi, sub.start_time, sub.end_time
+        )
+        return [o.id for o in ops]
+
+    def get_subscription(self, id, owner):
+        with self._lock:
+            sub = self._visible_sub(id)
+            if sub is None or sub.owner != owner:
+                raise errors.not_found(id)
+            out = dataclasses.replace(sub)
+            out.dependent_operations = self._dependent_ops_locked(sub)
+            return out
+
+    def upsert_subscription(self, sub):
+        with self._lock:
+            old = self._visible_sub(sub.id)
+            if old is None and sub.version != 0:
+                raise errors.not_found(sub.id)
+            if old is not None and sub.version == 0:
+                raise errors.already_exists(sub.id)
+            if old is not None and sub.version != old.version:
+                raise errors.version_mismatch("old version")
+            if old is not None and old.owner != sub.owner:
+                raise errors.permission_denied(
+                    f"Subscription is owned by {old.owner}"
+                )
+            count = self._sub_index.max_owner_count(
+                sub.cells, self._owners.intern(sub.owner), now=self._now_ns()
+            )
+            if count >= MAX_SCD_SUBSCRIPTIONS_PER_AREA:
+                msg = "too many existing subscriptions in this area already"
+                if old is not None:
+                    msg += ", rejecting update request"
+                raise errors.exhausted(msg)
+            stored = dataclasses.replace(
+                sub, version=(old.version if old else 0) + 1
+            )
+            self._subs[stored.id] = stored
+            self._index_scd_sub(stored)
+            self._journal({"t": "scd_sub_put", "doc": codec.scd_sub_to_doc(stored)})
+            affected = (
+                self._search_ops_locked(
+                    stored.cells,
+                    stored.altitude_lo,
+                    stored.altitude_hi,
+                    stored.start_time,
+                    stored.end_time,
+                )
+                if len(np.asarray(stored.cells).ravel())
+                else []
+            )
+            return dataclasses.replace(stored), affected
+
+    def delete_subscription(self, id, owner, version):
+        with self._lock:
+            old = self._visible_sub(id)
+            if old is None:
+                raise errors.not_found(id)
+            if version != 0 and version != old.version:
+                raise errors.version_mismatch("old version")
+            if old.owner != owner:
+                raise errors.permission_denied(f"ISA is owned by {old.owner}")
+            if any(o.subscription_id == id for o in self._ops.values()):
+                raise errors.bad_request(
+                    "failed to delete implicit subscription with active operation"
+                )
+            del self._subs[id]
+            self._sub_index.remove(id)
+            self._journal({"t": "scd_sub_del", "id": id})
+            return dataclasses.replace(old)
+
+    def search_subscriptions(self, cells, owner):
+        """Live subscriptions of `owner` intersecting cells.
+
+        The reference's SQL uses a LEFT JOIN (subscriptions.go:500-521)
+        which in effect ignores the cell filter; we implement the
+        intended inner-join semantics (cells do filter).
+        """
+        with self._lock:
+            if len(np.asarray(cells).ravel()) == 0:
+                raise errors.bad_request("no location provided")
+            ids = self._sub_index.query_ids(
+                cells, now=self._now_ns(), owner_id=self._owners.intern(owner)
+            )
+            out = []
+            for i in sorted(ids):
+                sub = self._subs.get(i)
+                if sub is None:
+                    continue
+                s = dataclasses.replace(sub)
+                s.dependent_operations = self._dependent_ops_locked(sub)
+                out.append(s)
+            return out
+
+    # -- WAL replay ----------------------------------------------------------
+
+    def apply_wal(self, rec: dict):
+        t = rec["t"]
+        if t == "scd_op_put":
+            op = codec.doc_to_op(rec["doc"])
+            self._ops[op.id] = op
+            self._index_op(op)
+        elif t == "scd_op_del":
+            self._ops.pop(rec["id"], None)
+            self._op_index.remove(rec["id"])
+        elif t == "scd_sub_put":
+            sub = codec.doc_to_scd_sub(rec["doc"])
+            self._subs[sub.id] = sub
+            self._index_scd_sub(sub)
+        elif t == "scd_sub_del":
+            self._subs.pop(rec["id"], None)
+            self._sub_index.remove(rec["id"])
+        elif t == "scd_sub_bump":
+            for i in rec["ids"]:
+                if i in self._subs:
+                    self._subs[i].notification_index += 1
+
+
+class DSSStore:
+    """One DSS region's storage: RID + SCD stores sharing a lock, a
+    commit-timestamp oracle, an owner interner, and a WAL."""
+
+    def __init__(
+        self,
+        *,
+        storage: str = "tpu",
+        clock: Optional[Clock] = None,
+        wal_path: Optional[str] = None,
+        wal_fsync: bool = False,
+    ):
+        if storage == "tpu":
+            index_factory = TpuSpatialIndex
+        elif storage == "memory":
+            index_factory = MemorySpatialIndex
+        else:
+            raise ValueError(f"unknown storage backend {storage!r}")
+        self.storage = storage
+        self.clock = clock or Clock()
+        self.wal = WriteAheadLog(wal_path, fsync=wal_fsync)
+        self._lock = threading.RLock()
+        ts = TimestampOracle(self.clock)
+        owners = OwnerInterner()
+        self.rid = RIDStoreImpl(
+            clock=self.clock,
+            ts_oracle=ts,
+            owners=owners,
+            lock=self._lock,
+            journal=self._journal,
+            index_factory=index_factory,
+        )
+        self.scd = SCDStoreImpl(
+            clock=self.clock,
+            ts_oracle=ts,
+            owners=owners,
+            lock=self._lock,
+            journal=self._journal,
+            index_factory=index_factory,
+        )
+        self._replaying = False
+        self._replay()
+
+    def _journal(self, rec: dict):
+        if not self._replaying:
+            self.wal.append(rec)
+
+    def _replay(self):
+        self._replaying = True
+        try:
+            for rec in self.wal.replay():
+                t = rec.get("t", "")
+                if t.startswith("isa") or t.startswith("rid"):
+                    self.rid.apply_wal(rec)
+                else:
+                    self.scd.apply_wal(rec)
+        finally:
+            self._replaying = False
+
+    def close(self):
+        self.wal.close()

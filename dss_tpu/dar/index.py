@@ -1,0 +1,112 @@
+"""Spatial index strategies behind the store implementations.
+
+MemorySpatialIndex — pure-python linear scan (the reference's in-memory
+test-fake analog, pkg/rid/application/isa_test.go:29-77).
+
+TpuSpatialIndex — the DarTable HBM index (dss_tpu.dar.snapshot); cell
+ids are compressed to int32 DAR keys on the way in.
+
+Both expose identical query semantics (the SQL COALESCE rules); the
+store contract tests run every scenario against both.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from dss_tpu.dar import oracle
+from dss_tpu.dar.oracle import Record
+from dss_tpu.dar.snapshot import DarTable
+from dss_tpu.geo import s2cell
+
+
+def _to_keys(cells_u64: np.ndarray) -> np.ndarray:
+    return s2cell.cell_to_dar_key(np.asarray(cells_u64, dtype=np.uint64))
+
+
+class MemorySpatialIndex:
+    def __init__(self):
+        self._recs: Dict[str, Record] = {}
+
+    def put(self, id, cells_u64, alt_lo, alt_hi, t_start, t_end, owner_id):
+        keys = np.unique(_to_keys(cells_u64))
+        self._recs[id] = Record(
+            entity_id=id,
+            keys=keys,
+            alt_lo=-np.inf if alt_lo is None else float(alt_lo),
+            alt_hi=np.inf if alt_hi is None else float(alt_hi),
+            t_start=int(t_start),
+            t_end=int(t_end),
+            owner_id=int(owner_id),
+        )
+
+    def remove(self, id):
+        self._recs.pop(id, None)
+
+    def query_ids(
+        self,
+        cells_u64,
+        alt_lo=None,
+        alt_hi=None,
+        t_start=None,
+        t_end=None,
+        *,
+        now,
+        owner_id=None,
+    ) -> List[str]:
+        keys = _to_keys(cells_u64)
+        recs = {i: r for i, r in enumerate(self._recs.values())}
+        slots = oracle.search(
+            recs, keys, alt_lo, alt_hi, t_start, t_end, now, owner_id
+        )
+        return [recs[s].entity_id for s in slots]
+
+    def max_owner_count(self, cells_u64, owner_id, *, now) -> int:
+        keys = _to_keys(cells_u64)
+        recs = {i: r for i, r in enumerate(self._recs.values())}
+        return oracle.max_count_per_cell(recs, keys, owner_id, now)
+
+
+class TpuSpatialIndex:
+    def __init__(self, **table_kwargs):
+        self._table = DarTable(**table_kwargs)
+
+    def put(self, id, cells_u64, alt_lo, alt_hi, t_start, t_end, owner_id):
+        self._table.upsert(
+            id, _to_keys(cells_u64), alt_lo, alt_hi, int(t_start), int(t_end), owner_id
+        )
+
+    def remove(self, id):
+        self._table.remove(id)
+
+    def query_ids(
+        self,
+        cells_u64,
+        alt_lo=None,
+        alt_hi=None,
+        t_start=None,
+        t_end=None,
+        *,
+        now,
+        owner_id=None,
+    ) -> List[str]:
+        return self._table.query(
+            _to_keys(cells_u64),
+            alt_lo,
+            alt_hi,
+            None if t_start is None else int(t_start),
+            None if t_end is None else int(t_end),
+            now=int(now),
+            owner_id=owner_id,
+        )
+
+    def max_owner_count(self, cells_u64, owner_id, *, now) -> int:
+        return self._table.max_owner_count(
+            _to_keys(cells_u64), owner_id, now=int(now)
+        )
+
+    @property
+    def table(self) -> DarTable:
+        return self._table

@@ -1,0 +1,65 @@
+"""Append-only write-ahead log: the durable source of truth.
+
+Plays the role CockroachDB plays in the reference (the DAR snapshot is
+a cache rebuilt from it; see SURVEY.md §5 checkpoint/resume).  Records
+are JSON lines {"seq": n, "t": type, ...}; replay applies them in order
+to rebuild store state.  fsync per append is configurable (off by
+default: group-commit style durability is the deployment's call, like
+the reference's reliance on CRDB commit semantics).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Callable, Iterator, Optional
+
+
+class WriteAheadLog:
+    def __init__(self, path: Optional[str], fsync: bool = False):
+        """path=None -> disabled (in-memory deployments / tests)."""
+        self.path = path
+        self.fsync = fsync
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._fh = None
+        if path is not None:
+            os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+            # recover the sequence number from an existing log
+            if os.path.exists(path):
+                for rec in self.replay():
+                    self._seq = max(self._seq, rec.get("seq", 0))
+            self._fh = open(path, "a", encoding="utf-8")
+
+    def append(self, record: dict) -> int:
+        with self._lock:
+            self._seq += 1
+            record = dict(record, seq=self._seq)
+            if self._fh is not None:
+                self._fh.write(json.dumps(record, separators=(",", ":")) + "\n")
+                self._fh.flush()
+                if self.fsync:
+                    os.fsync(self._fh.fileno())
+            return self._seq
+
+    def replay(self) -> Iterator[dict]:
+        """Yield records in order; tolerates a torn final line."""
+        if self.path is None or not os.path.exists(self.path):
+            return
+        with open(self.path, "r", encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    yield json.loads(line)
+                except json.JSONDecodeError:
+                    # torn tail write (crash mid-append): stop replay here
+                    return
+
+    def close(self):
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
