@@ -1,0 +1,273 @@
+"""SCD service: operation references + subscriptions + constraint stubs.
+
+Mirrors pkg/scd: PutOperationReference with multi-volume extent union,
+implicit subscriptions, OVN key checks with the AirspaceConflict
+response on missing OVNs (operations_handler.go:171-309), subscription
+lifecycle (subscriptions_handler.go), and the not-yet-implemented
+constraint handlers (constraints_handler.go:12-30).
+"""
+
+from __future__ import annotations
+
+import uuid as uuidlib
+from typing import List, Optional
+
+import numpy as np
+
+from dss_tpu import errors
+from dss_tpu.clock import Clock
+from dss_tpu.dar.store import SCDStore
+from dss_tpu.geo import covering as geo_covering
+from dss_tpu.models import scd as scdm
+from dss_tpu.models.core import validate_uss_base_url
+from dss_tpu.models.volumes import union_volumes_4d
+from dss_tpu.services import serialization as ser
+
+
+def _area_error(e: Exception):
+    if isinstance(e, geo_covering.AreaTooLargeError):
+        return errors.area_too_large(str(e))
+    return errors.bad_request(f"bad area: {e}")
+
+
+def _missing_ovns_response(ops: List[scdm.Operation], owner: str) -> dict:
+    """The AirspaceConflictResponse body (pkg/scd/errors/errors.go:22-53);
+    OVNs of other owners' operations are included — that is the point of
+    the response (the caller needs them for its key)."""
+    return {
+        "message": (
+            "at least one current operation is missing from the key; "
+            "no changes have been made"
+        ),
+        "entity_conflicts": [
+            {"operation_reference": ser.op_to_json(op)} for op in ops
+        ],
+    }
+
+
+class SCDService:
+    def __init__(self, store: SCDStore, clock: Clock):
+        self.store = store
+        self.clock = clock
+
+    # -- Operation references ------------------------------------------------
+
+    def put_operation(self, entity_uuid: str, params: dict, owner: str) -> dict:
+        if not entity_uuid:
+            raise errors.bad_request("missing Operation ID")
+        if not params.get("uss_base_url"):
+            raise errors.bad_request("missing required UssBaseUrl")
+        extents_json = params.get("extents") or []
+        extents = [ser.volume4d_from_scd_json(e) for e in extents_json]
+        try:
+            u_extent = union_volumes_4d(extents)
+        except geo_covering.AreaTooLargeError as e:
+            raise errors.area_too_large(str(e))
+        except (geo_covering.BadAreaError, ValueError) as e:
+            raise errors.bad_request(f"failed to union extents: {e}")
+        if u_extent.start_time is None:
+            raise errors.bad_request("missing time_start from extents")
+        if u_extent.end_time is None:
+            raise errors.bad_request("missing time_end from extents")
+        try:
+            cells = u_extent.calculate_spatial_covering()
+        except geo_covering.AreaTooLargeError as e:
+            raise errors.area_too_large(str(e))
+        except (geo_covering.BadAreaError, ValueError) as e:
+            raise _area_error(e)
+
+        subscription_id = params.get("subscription_id") or ""
+        key = [str(k) for k in params.get("key", [])]
+
+        with self.store.transaction():
+            if not subscription_id:
+                new_sub = params.get("new_subscription") or {}
+                try:
+                    validate_uss_base_url(new_sub.get("uss_base_url", ""))
+                except ValueError as e:
+                    raise errors.bad_request(str(e))
+                sub, _ = self.store.upsert_subscription(
+                    scdm.Subscription(
+                        id=str(uuidlib.uuid4()),
+                        owner=owner,
+                        start_time=u_extent.start_time,
+                        end_time=u_extent.end_time,
+                        altitude_lo=u_extent.spatial_volume.altitude_lo,
+                        altitude_hi=u_extent.spatial_volume.altitude_hi,
+                        cells=cells,
+                        base_url=new_sub.get("uss_base_url", ""),
+                        notify_for_operations=True,
+                        notify_for_constraints=new_sub.get(
+                            "notify_for_constraints", False
+                        ),
+                        implicit_subscription=True,
+                    )
+                )
+                subscription_id = sub.id
+
+            op = scdm.Operation(
+                id=entity_uuid,
+                owner=owner,
+                version=int(params.get("old_version", 0)),
+                start_time=u_extent.start_time,
+                end_time=u_extent.end_time,
+                altitude_lower=u_extent.spatial_volume.altitude_lo,
+                altitude_upper=u_extent.spatial_volume.altitude_hi,
+                cells=cells,
+                uss_base_url=params["uss_base_url"],
+                subscription_id=subscription_id,
+                state=params.get("state", ""),
+            )
+            try:
+                stored, subs = self.store.upsert_operation(op, key)
+            except errors.StatusError as e:
+                if e.code == errors.Code.MISSING_OVNS:
+                    # re-search for the full conflict set and attach the
+                    # AirspaceConflictResponse payload
+                    ops = self.store.search_operations(
+                        cells,
+                        u_extent.spatial_volume.altitude_lo,
+                        u_extent.spatial_volume.altitude_hi,
+                        u_extent.start_time,
+                        u_extent.end_time,
+                    )
+                    e.details = _missing_ovns_response(ops, owner)
+                raise
+        return {
+            "operation_reference": ser.op_to_json(stored),
+            "subscribers": ser.scd_subscribers_to_notify_json(subs),
+        }
+
+    def get_operation(self, entity_uuid: str, owner: str) -> dict:
+        if not entity_uuid:
+            raise errors.bad_request("missing Operation ID")
+        op = self.store.get_operation(entity_uuid)
+        if op.owner != owner:
+            op.ovn = ""  # OVNs are private to the owner
+        return {"operation_reference": ser.op_to_json(op)}
+
+    def delete_operation(self, entity_uuid: str, owner: str) -> dict:
+        if not entity_uuid:
+            raise errors.bad_request("missing Operation ID")
+        with self.store.transaction():
+            op, subs = self.store.delete_operation(entity_uuid, owner)
+        return {
+            "operation_reference": ser.op_to_json(op),
+            "subscribers": ser.scd_subscribers_to_notify_json(subs),
+        }
+
+    def search_operations(self, params: dict, owner: str) -> dict:
+        aoi = params.get("area_of_interest")
+        if aoi is None:
+            raise errors.bad_request("missing area_of_interest")
+        vol4 = ser.volume4d_from_scd_json(aoi)
+        try:
+            cells = vol4.calculate_spatial_covering()
+        except geo_covering.AreaTooLargeError as e:
+            raise errors.area_too_large(str(e))
+        except (geo_covering.BadAreaError, ValueError) as e:
+            raise _area_error(e)
+        sv = vol4.spatial_volume
+        ops = self.store.search_operations(
+            cells, sv.altitude_lo, sv.altitude_hi, vol4.start_time, vol4.end_time
+        )
+        out = []
+        for op in ops:
+            if op.owner != owner:
+                op.ovn = ""
+            out.append(ser.op_to_json(op))
+        return {"operation_references": out}
+
+    # -- Subscriptions -------------------------------------------------------
+
+    def put_subscription(self, subscription_id: str, params: dict, owner: str) -> dict:
+        if not subscription_id:
+            raise errors.bad_request("missing Subscription ID")
+        extents = ser.volume4d_from_scd_json(params.get("extents") or {})
+        try:
+            cells = (
+                extents.calculate_spatial_covering()
+                if extents.spatial_volume and extents.spatial_volume.footprint
+                else np.array([], np.uint64)
+            )
+        except geo_covering.AreaTooLargeError as e:
+            raise errors.area_too_large(str(e))
+        except (geo_covering.BadAreaError, ValueError) as e:
+            raise _area_error(e)
+        sub = scdm.Subscription(
+            id=subscription_id,
+            owner=owner,
+            version=int(params.get("old_version", 0)),
+            start_time=extents.start_time,
+            end_time=extents.end_time,
+            altitude_lo=(
+                extents.spatial_volume.altitude_lo if extents.spatial_volume else None
+            ),
+            altitude_hi=(
+                extents.spatial_volume.altitude_hi if extents.spatial_volume else None
+            ),
+            cells=cells,
+            base_url=params.get("uss_base_url", ""),
+            notify_for_operations=bool(params.get("notify_for_operations", False)),
+            notify_for_constraints=bool(params.get("notify_for_constraints", False)),
+        )
+        if not sub.notify_for_operations and not sub.notify_for_constraints:
+            raise errors.bad_request(
+                "no notification triggers requested for Subscription"
+            )
+        # NOTE: the reference passes the new subscription as its own `old`
+        # here (subscriptions_handler.go:76), which nil-derefs when
+        # time_start is omitted; we use the sane old=None defaulting.
+        sub.adjust_time_range(self.clock.now(), None)
+        with self.store.transaction():
+            stored, ops = self.store.upsert_subscription(sub)
+        result = {"subscription": ser.scd_sub_to_json(stored), "operations": []}
+        for op in ops:
+            if op.owner != owner:
+                op.ovn = ""
+            result["operations"].append(ser.op_to_json(op))
+        return result
+
+    def get_subscription(self, subscription_id: str, owner: str) -> dict:
+        if not subscription_id:
+            raise errors.bad_request("missing Subscription ID")
+        sub = self.store.get_subscription(subscription_id, owner)
+        return {"subscription": ser.scd_sub_to_json(sub)}
+
+    def query_subscriptions(self, params: dict, owner: str) -> dict:
+        aoi = params.get("area_of_interest")
+        if aoi is None:
+            raise errors.bad_request("missing area_of_interest")
+        vol4 = ser.volume4d_from_scd_json(aoi)
+        try:
+            cells = vol4.calculate_spatial_covering()
+        except geo_covering.AreaTooLargeError as e:
+            raise errors.area_too_large(str(e))
+        except (geo_covering.BadAreaError, ValueError) as e:
+            raise _area_error(e)
+        subs = self.store.search_subscriptions(cells, owner)
+        return {"subscriptions": [ser.scd_sub_to_json(s) for s in subs]}
+
+    def delete_subscription(self, subscription_id: str, owner: str) -> dict:
+        if not subscription_id:
+            raise errors.bad_request("missing Subscription ID")
+        with self.store.transaction():
+            sub = self.store.delete_subscription(subscription_id, owner, 0)
+        return {"subscription": ser.scd_sub_to_json(sub)}
+
+    # -- Constraints (stubbed, constraints_handler.go:12-30) -----------------
+
+    def get_constraint(self, *_args, **_kw):
+        raise errors.bad_request("not yet implemented")
+
+    def put_constraint(self, *_args, **_kw):
+        raise errors.bad_request("not yet implemented")
+
+    def delete_constraint(self, *_args, **_kw):
+        raise errors.bad_request("not yet implemented")
+
+    def query_constraints(self, *_args, **_kw):
+        raise errors.bad_request("not yet implemented")
+
+    def make_dss_report(self, *_args, **_kw):
+        raise errors.bad_request("not yet implemented")
