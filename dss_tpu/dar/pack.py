@@ -1,0 +1,105 @@
+"""Shared Record -> packed-array layout for DAR snapshots.
+
+Single source of truth for how host Records become the device
+EntityTable columns + sorted postings, used by both the single-chip
+DarTable rebuild (dss_tpu.dar.snapshot) and the multi-chip read
+replica (dss_tpu.parallel.sharded.ShardedDar), so the two can never
+disagree on sentinel conventions or candidate-run capacity.
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple
+
+import numpy as np
+
+from dss_tpu.dar.oracle import Record
+from dss_tpu.ops.conflict import INT32_MAX, NO_TIME_HI, NO_TIME_LO
+
+
+def pow2_at_least(n: int, lo: int = 8) -> int:
+    v = lo
+    while v < n:
+        v *= 2
+    return v
+
+
+class PackedRecords(NamedTuple):
+    """Numpy (host) form of the device layout.  Row `capacity` of the
+    entity columns is the inactive sentinel all padded gathers hit."""
+
+    alt_lo: np.ndarray  # f32[capacity+1]
+    alt_hi: np.ndarray  # f32[capacity+1]
+    t_start: np.ndarray  # i64[capacity+1]
+    t_end: np.ndarray  # i64[capacity+1]
+    active: np.ndarray  # bool[capacity+1]
+    owner: np.ndarray  # i32[capacity+1]
+    post_key: np.ndarray  # i32[P] sorted, pad INT32_MAX
+    post_ent: np.ndarray  # i32[P], pad = capacity (sentinel)
+    capacity: int  # entity slots (sentinel excluded)
+    base_cap: int  # max postings run per key, rounded up to pow2
+    n_postings: int  # live postings before padding
+
+
+def pack_records(
+    records: List[Record],
+    *,
+    capacity: int = None,
+    pad_postings: bool = True,
+) -> PackedRecords:
+    """Pack Records slot-by-index into entity columns + sorted postings."""
+    n = len(records)
+    if capacity is None:
+        capacity = max(n, 1)
+    if capacity < n:
+        raise ValueError(f"capacity {capacity} < {n} records")
+
+    alt_lo = np.full(capacity + 1, np.inf, np.float32)
+    alt_hi = np.full(capacity + 1, -np.inf, np.float32)
+    t_start = np.full(capacity + 1, NO_TIME_HI, np.int64)
+    t_end = np.full(capacity + 1, NO_TIME_LO, np.int64)
+    active = np.zeros(capacity + 1, np.bool_)
+    owner = np.full(capacity + 1, -1, np.int32)
+
+    total = sum(len(r.keys) for r in records)
+    pk = np.empty(total, np.int32)
+    pe = np.empty(total, np.int32)
+    ofs = 0
+    for slot, rec in enumerate(records):
+        alt_lo[slot] = rec.alt_lo
+        alt_hi[slot] = rec.alt_hi
+        t_start[slot] = rec.t_start
+        t_end[slot] = rec.t_end
+        active[slot] = True
+        owner[slot] = rec.owner_id
+        pk[ofs : ofs + len(rec.keys)] = rec.keys
+        pe[ofs : ofs + len(rec.keys)] = slot
+        ofs += len(rec.keys)
+    order = np.argsort(pk, kind="stable")
+    pk, pe = pk[order], pe[order]
+    if total:
+        _, counts = np.unique(pk, return_counts=True)
+        base_cap = pow2_at_least(int(counts.max()), lo=8)
+    else:
+        base_cap = 8
+    if pad_postings:
+        pad = pow2_at_least(max(total, 8), lo=8)
+        post_key = np.full(pad, INT32_MAX, np.int32)
+        post_ent = np.full(pad, capacity, np.int32)
+        post_key[:total] = pk
+        post_ent[:total] = pe
+    else:
+        post_key, post_ent = pk, pe
+    return PackedRecords(
+        alt_lo=alt_lo,
+        alt_hi=alt_hi,
+        t_start=t_start,
+        t_end=t_end,
+        active=active,
+        owner=owner,
+        post_key=post_key,
+        post_ent=post_ent,
+        capacity=capacity,
+        base_cap=base_cap,
+        n_postings=total,
+    )

@@ -26,6 +26,7 @@ import numpy as np
 
 from dss_tpu.dar import oracle
 from dss_tpu.dar.oracle import Record
+from dss_tpu.dar.pack import pack_records, pow2_at_least
 from dss_tpu.ops.conflict import (
     INT32_MAX,
     NO_TIME_HI,
@@ -46,13 +47,6 @@ def _bucket(n: int, buckets=_QUERY_BUCKETS) -> int:
         if n <= b:
             return b
     raise ValueError(f"query too wide: {n} cells (max {buckets[-1]})")
-
-
-def _pow2_at_least(n: int, lo: int = 8) -> int:
-    v = lo
-    while v < n:
-        v *= 2
-    return v
 
 
 @jax.jit
@@ -228,63 +222,29 @@ class DarTable:
         live = list(self.records.values())
         if pending is not None:
             live.append(pending)
-        need = max(len(live), 1)
-        capacity = _pow2_at_least(need * 2, lo=1024)
+        capacity = pow2_at_least(max(len(live), 1) * 2, lo=1024)
         self._entity_capacity = capacity
 
-        self.records = {}
-        self.slot_of = {}
+        self.records = dict(enumerate(live))
+        self.slot_of = {rec.entity_id: slot for slot, rec in self.records.items()}
         self._next_slot = len(live)
 
-        alt_lo = np.full(capacity + 1, np.inf, np.float32)
-        alt_hi = np.full(capacity + 1, -np.inf, np.float32)
-        t_start = np.full(capacity + 1, NO_TIME_HI, np.int64)
-        t_end = np.full(capacity + 1, NO_TIME_LO, np.int64)
-        active = np.zeros(capacity + 1, np.bool_)
-        owner = np.full(capacity + 1, -1, np.int32)
-
-        total_postings = sum(len(r.keys) for r in live)
-        pk = np.empty(total_postings, np.int32)
-        pe = np.empty(total_postings, np.int32)
-        ofs = 0
-        for slot, rec in enumerate(live):
-            self.records[slot] = rec
-            self.slot_of[rec.entity_id] = slot
-            alt_lo[slot] = rec.alt_lo
-            alt_hi[slot] = rec.alt_hi
-            t_start[slot] = rec.t_start
-            t_end[slot] = rec.t_end
-            active[slot] = True
-            owner[slot] = rec.owner_id
-            pk[ofs : ofs + len(rec.keys)] = rec.keys
-            pe[ofs : ofs + len(rec.keys)] = slot
-            ofs += len(rec.keys)
-        order = np.argsort(pk, kind="stable")
-        pk = pk[order]
-        pe = pe[order]
-        if total_postings:
-            _, counts = np.unique(pk, return_counts=True)
-            self.base_cap = _pow2_at_least(int(counts.max()), lo=8)
-        else:
-            self.base_cap = 8
-        pad = _pow2_at_least(max(total_postings, 8), lo=8)
-        base_key = np.full(pad, INT32_MAX, np.int32)
-        base_ent = np.full(pad, capacity, np.int32)
-        base_key[:total_postings] = pk
-        base_ent[:total_postings] = pe
-        self._base_key = base_key
-        self._base_ent = base_ent
+        packed = pack_records(live, capacity=capacity)
+        self.base_cap = packed.base_cap
+        self._base_key = packed.post_key
+        self._base_ent = packed.post_ent
 
         self._ents = EntityTable(
-            alt_lo=jnp.asarray(alt_lo),
-            alt_hi=jnp.asarray(alt_hi),
-            t_start=jnp.asarray(t_start),
-            t_end=jnp.asarray(t_end),
-            active=jnp.asarray(active),
-            owner=jnp.asarray(owner),
+            alt_lo=jnp.asarray(packed.alt_lo),
+            alt_hi=jnp.asarray(packed.alt_hi),
+            t_start=jnp.asarray(packed.t_start),
+            t_end=jnp.asarray(packed.t_end),
+            active=jnp.asarray(packed.active),
+            owner=jnp.asarray(packed.owner),
         )
         self._base = Postings(
-            post_key=jnp.asarray(base_key), post_ent=jnp.asarray(base_ent)
+            post_key=jnp.asarray(packed.post_key),
+            post_ent=jnp.asarray(packed.post_ent),
         )
         self._delta_key[:] = INT32_MAX
         self._delta_ent[:] = 0
@@ -293,6 +253,16 @@ class DarTable:
 
     def rebuild(self):
         with self._lock:
+            self._rebuild_locked()
+
+    def bulk_load(self, records) -> None:
+        """Replace the table contents with `records` (list of Record) in
+        one rebuild — the snapshot-refresh path (WAL replay / bench
+        population) that skips per-entity delta churn.  Duplicate
+        entity_ids keep the last occurrence (WAL replay order)."""
+        with self._lock:
+            by_id = {r.entity_id: r for r in records}
+            self.records = dict(enumerate(by_id.values()))
             self._rebuild_locked()
 
     # -- read path -----------------------------------------------------------

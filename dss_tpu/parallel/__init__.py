@@ -1,0 +1,29 @@
+"""Multi-chip DAR sharding.
+
+The reference scales reads via CockroachDB range sharding over the S2
+cell keyspace (implementation_details.md:11-42); here the same role is
+played by a `jax.sharding.Mesh` with two axes:
+
+    dp — query-batch data parallelism (each chip answers a slice of the
+         query batch),
+    sp — spatial model parallelism (the sorted postings array is split
+         into contiguous cell-key ranges, one per chip; candidate sets
+         are merged with an all_gather over ICI).
+
+The EntityTable (attribute columns) is replicated — it is small
+relative to postings and every shard needs random access to it.
+"""
+
+from dss_tpu.parallel.mesh import make_mesh
+from dss_tpu.parallel.sharded import (
+    ShardedDar,
+    shard_postings,
+    sharded_conflict_query_batch,
+)
+
+__all__ = [
+    "make_mesh",
+    "ShardedDar",
+    "shard_postings",
+    "sharded_conflict_query_batch",
+]

@@ -1,0 +1,48 @@
+"""Mesh construction for the DAR query fabric.
+
+Axes: ("dp", "sp") — query-batch data parallelism x spatial postings
+sharding.  On a v5e-8 the default factoring is dp=2 x sp=4: postings
+ranges ride the fast ICI ring inside each sp group, and two independent
+query streams run in parallel.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def _factor(n: int) -> Tuple[int, int]:
+    """Default (dp, sp) factoring: spatial sharding is the scaling
+    dimension, but keep a dp=2 query-stream axis once there are >=4
+    chips (v5e-8 default: dp=2 x sp=4)."""
+    dp = 2 if (n >= 4 and n % 2 == 0) else 1
+    return dp, n // dp
+
+
+def make_mesh(
+    n_devices: Optional[int] = None,
+    *,
+    dp: Optional[int] = None,
+    sp: Optional[int] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a ("dp", "sp") mesh over the first n_devices devices."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is None:
+        n_devices = len(devices)
+    devices = list(devices)[:n_devices]
+    if dp is None and sp is None:
+        dp, sp = _factor(n_devices)
+    elif dp is None:
+        dp = n_devices // sp
+    elif sp is None:
+        sp = n_devices // dp
+    if dp * sp != n_devices:
+        raise ValueError(f"dp*sp = {dp}*{sp} != n_devices = {n_devices}")
+    arr = np.asarray(devices).reshape(dp, sp)
+    return Mesh(arr, ("dp", "sp"))

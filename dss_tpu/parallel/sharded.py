@@ -1,0 +1,294 @@
+"""Sharded DAR conflict queries: shard_map over a ("dp", "sp") mesh.
+
+Replaces the reference's CRDB range layer for the read path
+(implementation_details.md:11-42 — ranges shard the cell keyspace, any
+node proxies to the right range).  Here:
+
+  - the globally-sorted postings array is split into `sp` contiguous
+    cell-key ranges (equal postings counts, so load is balanced even
+    when cell occupancy is skewed);
+  - each device runs the single-chip candidate gather + 4D attribute
+    test (dss_tpu.ops.conflict) against its local range and compacts
+    its hits to a fixed width;
+  - per-shard results are merged with an all_gather over the "sp" axis
+    (ICI) and dedup-compacted — the SQL DISTINCT across ranges;
+  - the query batch itself is sharded over "dp": independent query
+    streams never communicate.
+
+The EntityTable is replicated: attribute columns are ~29 B/entity
+(vs ~8 B/posting x ~dozens of postings/entity), and every shard needs
+random access to attributes of slots its postings name.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dss_tpu.dar import oracle
+from dss_tpu.dar.oracle import Record
+from dss_tpu.dar.pack import pack_records
+from dss_tpu.ops.conflict import (
+    INT32_MAX,
+    NO_TIME_HI,
+    NO_TIME_LO,
+    EntityTable,
+    Postings,
+    QuerySpec,
+    _attr_test,
+    _candidates,
+    _compact_unique,
+)
+
+
+def shard_postings(
+    post_key: np.ndarray,
+    post_ent: np.ndarray,
+    n_sp: int,
+    sentinel_slot: int,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Split sorted postings into n_sp equal contiguous ranges.
+
+    Returns ([n_sp, Ps] keys, [n_sp, Ps] slots), each row sorted, padded
+    with INT32_MAX / sentinel.  Splitting by postings *count* (not key
+    range) balances load under skewed cell occupancy; contiguity keeps
+    each row sorted so per-shard searchsorted still works.
+    """
+    live = post_key != INT32_MAX
+    pk = np.asarray(post_key)[live]
+    pe = np.asarray(post_ent)[live]
+    n = len(pk)
+    ps = max((n + n_sp - 1) // n_sp, 8)
+    keys = np.full((n_sp, ps), INT32_MAX, np.int32)
+    ents = np.full((n_sp, ps), sentinel_slot, np.int32)
+    for i in range(n_sp):
+        lo, hi = i * ps, min((i + 1) * ps, n)
+        if lo < n:
+            keys[i, : hi - lo] = pk[lo:hi]
+            ents[i, : hi - lo] = pe[lo:hi]
+    return keys, ents
+
+
+def _local_query(
+    post: Postings,
+    ents: EntityTable,
+    q: QuerySpec,
+    now,
+    owner,
+    *,
+    cap: int,
+    shard_results: int,
+    with_owner: bool,
+):
+    """Per-device: candidates from the local postings range, 4D test,
+    compact to shard_results.  Returns (slots [Q, sr], n_unique [Q])."""
+
+    def one(qq, ow):
+        ent, valid = _candidates(post, ents, qq.keys, cap)
+        hit = valid & _attr_test(
+            ents, ent, qq, now, ow if with_owner else None
+        )
+        return _compact_unique(ent, hit, shard_results)
+
+    if with_owner:
+        return jax.vmap(one)(q, owner)
+    return jax.vmap(one, in_axes=(0, None))(q, jnp.int32(0))
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "mesh",
+        "cap",
+        "shard_results",
+        "max_results",
+        "with_owner",
+    ),
+)
+def sharded_conflict_query_batch(
+    post_key,  # [n_sp, Ps] int32, rows sorted, pad INT32_MAX
+    post_ent,  # [n_sp, Ps] int32
+    ents: EntityTable,  # replicated
+    q: QuerySpec,  # leading batch axis Q, Q % dp == 0
+    now,
+    owner=None,  # [Q] int32 when with_owner
+    *,
+    mesh: Mesh,
+    cap: int,
+    shard_results: int,
+    max_results: int,
+    with_owner: bool = False,
+):
+    """Batched sharded query.  Returns (slots [Q, max_results] padded
+    with INT32_MAX, overflowed [Q] bool)."""
+    owner_arr = owner if with_owner else jnp.zeros(q.keys.shape[0], jnp.int32)
+
+    def step(pk, pe, ents, keys, alo, ahi, ts, te, now, ow):
+        post = Postings(post_key=pk[0], post_ent=pe[0])
+        qq = QuerySpec(keys=keys, alt_lo=alo, alt_hi=ahi, t_start=ts, t_end=te)
+        slots_s, n_uni = _local_query(
+            post,
+            ents,
+            qq,
+            now,
+            ow,
+            cap=cap,
+            shard_results=shard_results,
+            with_owner=with_owner,
+        )
+        shard_ovf = n_uni > shard_results  # [Qloc]
+        gathered = jax.lax.all_gather(slots_s, "sp")  # [n_sp, Qloc, sr]
+        merged = jnp.moveaxis(gathered, 0, 1).reshape(slots_s.shape[0], -1)
+
+        def compact(m):
+            return _compact_unique(m, m != INT32_MAX, max_results)
+
+        out, n_unique = jax.vmap(compact)(merged)
+        ovf = (
+            jax.lax.psum(shard_ovf.astype(jnp.int32), "sp") > 0
+        ) | (n_unique > max_results)
+        return out, ovf
+
+    qspec = P("dp")
+    return shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(
+            P("sp", None),  # post_key
+            P("sp", None),  # post_ent
+            P(),  # ents (replicated)
+            P("dp", None),  # q.keys
+            qspec,
+            qspec,
+            qspec,
+            qspec,  # q scalars-per-query
+            P(),  # now
+            qspec,  # owner
+        ),
+        out_specs=(P("dp", None), P("dp")),
+        check_vma=False,
+    )(
+        post_key,
+        post_ent,
+        ents,
+        q.keys,
+        q.alt_lo,
+        q.alt_hi,
+        q.t_start,
+        q.t_end,
+        now,
+        owner_arr,
+    )
+
+
+class ShardedDar:
+    """A read-only sharded snapshot of a DAR entity class.
+
+    Built from host Records (e.g. a DarTable's authoritative state or a
+    WAL replay); holds device arrays laid out for the mesh.  This is
+    the multi-chip read replica — writes go through the single-chip
+    DarTable / WAL and periodically refresh this snapshot, mirroring
+    the reference's CRDB-as-source-of-truth split (SURVEY.md §7).
+    """
+
+    def __init__(
+        self,
+        records: List[Record],
+        mesh: Mesh,
+        *,
+        max_results: int = 512,
+        shard_results: Optional[int] = None,
+    ):
+        self.mesh = mesh
+        self.n_sp = mesh.shape["sp"]
+        self.dp = mesh.shape["dp"]
+        self.max_results = max_results
+        self.shard_results = shard_results or max_results
+        self.records = {slot: r for slot, r in enumerate(records)}
+
+        packed = pack_records(records, pad_postings=False)
+        self.cap = packed.base_cap
+        skey, sent = shard_postings(
+            packed.post_key, packed.post_ent, self.n_sp, packed.capacity
+        )
+
+        repl = NamedSharding(mesh, P())
+        sp_sh = NamedSharding(mesh, P("sp", None))
+        self.post_key = jax.device_put(skey, sp_sh)
+        self.post_ent = jax.device_put(sent, sp_sh)
+        self.ents = EntityTable(
+            alt_lo=jax.device_put(packed.alt_lo, repl),
+            alt_hi=jax.device_put(packed.alt_hi, repl),
+            t_start=jax.device_put(packed.t_start, repl),
+            t_end=jax.device_put(packed.t_end, repl),
+            active=jax.device_put(packed.active, repl),
+            owner=jax.device_put(packed.owner, repl),
+        )
+
+    def query_batch(
+        self,
+        keys_batch: np.ndarray,  # [Q, K] int32 DAR keys, pad -1
+        alt_lo: np.ndarray,  # [Q] f32
+        alt_hi: np.ndarray,
+        t_start: np.ndarray,  # [Q] i64
+        t_end: np.ndarray,
+        *,
+        now: int,
+    ):
+        """Run a batch of queries; returns list-of-lists of entity slots."""
+        qn = keys_batch.shape[0]
+        pad = (-qn) % self.dp
+        if pad:
+            keys_batch = np.concatenate(
+                [keys_batch, np.full((pad, keys_batch.shape[1]), -1, np.int32)]
+            )
+            alt_lo = np.concatenate([alt_lo, np.full(pad, -np.inf, np.float32)])
+            alt_hi = np.concatenate([alt_hi, np.full(pad, np.inf, np.float32)])
+            t_start = np.concatenate([t_start, np.full(pad, NO_TIME_LO)])
+            t_end = np.concatenate([t_end, np.full(pad, NO_TIME_HI)])
+        spec = QuerySpec(
+            keys=jnp.asarray(keys_batch, jnp.int32),
+            alt_lo=jnp.asarray(alt_lo, jnp.float32),
+            alt_hi=jnp.asarray(alt_hi, jnp.float32),
+            t_start=jnp.asarray(t_start, jnp.int64),
+            t_end=jnp.asarray(t_end, jnp.int64),
+        )
+        slots, ovf = sharded_conflict_query_batch(
+            self.post_key,
+            self.post_ent,
+            self.ents,
+            spec,
+            jnp.int64(now),
+            mesh=self.mesh,
+            cap=self.cap,
+            shard_results=self.shard_results,
+            max_results=self.max_results,
+        )
+        slots = np.asarray(slots)[:qn]
+        ovf = np.asarray(ovf)[:qn]
+        out = []
+        for i in range(qn):
+            if ovf[i]:
+                out.append(
+                    oracle.search(
+                        self.records,
+                        keys_batch[i][keys_batch[i] >= 0],
+                        None
+                        if alt_lo[i] == -np.inf
+                        else float(alt_lo[i]),
+                        None if alt_hi[i] == np.inf else float(alt_hi[i]),
+                        None if t_start[i] == NO_TIME_LO else int(t_start[i]),
+                        None if t_end[i] == NO_TIME_HI else int(t_end[i]),
+                        now,
+                    )
+                )
+            else:
+                row = slots[i]
+                out.append([int(s) for s in row[row != INT32_MAX]])
+        return out

@@ -151,12 +151,12 @@ class RIDService:
             try:
                 earliest = ser.parse_time(earliest_time)
             except ValueError as e:
-                raise errors.internal(str(e))
+                raise errors.bad_request(f"bad earliest_time: {e}")
         if latest_time:
             try:
                 latest = ser.parse_time(latest_time)
             except ValueError as e:
-                raise errors.internal(str(e))
+                raise errors.bad_request(f"bad latest_time: {e}")
         # clamp earliest to now (application/isa.go:38-45)
         now = self.clock.now()
         if earliest is None or earliest < now:

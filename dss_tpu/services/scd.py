@@ -30,7 +30,7 @@ def _area_error(e: Exception):
     return errors.bad_request(f"bad area: {e}")
 
 
-def _missing_ovns_response(ops: List[scdm.Operation], owner: str) -> dict:
+def _missing_ovns_response(ops: List[scdm.Operation]) -> dict:
     """The AirspaceConflictResponse body (pkg/scd/errors/errors.go:22-53);
     OVNs of other owners' operations are included — that is the point of
     the response (the caller needs them for its key)."""
@@ -71,13 +71,15 @@ class SCDService:
             raise errors.bad_request("missing time_end from extents")
         try:
             cells = u_extent.calculate_spatial_covering()
-        except geo_covering.AreaTooLargeError as e:
-            raise errors.area_too_large(str(e))
-        except (geo_covering.BadAreaError, ValueError) as e:
+        except (
+            geo_covering.AreaTooLargeError,
+            geo_covering.BadAreaError,
+            ValueError,
+        ) as e:
             raise _area_error(e)
 
         subscription_id = params.get("subscription_id") or ""
-        key = [str(k) for k in params.get("key", [])]
+        key = [str(k) for k in (params.get("key") or [])]
 
         with self.store.transaction():
             if not subscription_id:
@@ -108,7 +110,7 @@ class SCDService:
             op = scdm.Operation(
                 id=entity_uuid,
                 owner=owner,
-                version=int(params.get("old_version", 0)),
+                version=ser.int_field(params.get("old_version"), "old_version"),
                 start_time=u_extent.start_time,
                 end_time=u_extent.end_time,
                 altitude_lower=u_extent.spatial_volume.altitude_lo,
@@ -131,7 +133,7 @@ class SCDService:
                         u_extent.start_time,
                         u_extent.end_time,
                     )
-                    e.details = _missing_ovns_response(ops, owner)
+                    e.details = _missing_ovns_response(ops)
                 raise
         return {
             "operation_reference": ser.op_to_json(stored),
@@ -163,9 +165,11 @@ class SCDService:
         vol4 = ser.volume4d_from_scd_json(aoi)
         try:
             cells = vol4.calculate_spatial_covering()
-        except geo_covering.AreaTooLargeError as e:
-            raise errors.area_too_large(str(e))
-        except (geo_covering.BadAreaError, ValueError) as e:
+        except (
+            geo_covering.AreaTooLargeError,
+            geo_covering.BadAreaError,
+            ValueError,
+        ) as e:
             raise _area_error(e)
         sv = vol4.spatial_volume
         ops = self.store.search_operations(
@@ -190,14 +194,16 @@ class SCDService:
                 if extents.spatial_volume and extents.spatial_volume.footprint
                 else np.array([], np.uint64)
             )
-        except geo_covering.AreaTooLargeError as e:
-            raise errors.area_too_large(str(e))
-        except (geo_covering.BadAreaError, ValueError) as e:
+        except (
+            geo_covering.AreaTooLargeError,
+            geo_covering.BadAreaError,
+            ValueError,
+        ) as e:
             raise _area_error(e)
         sub = scdm.Subscription(
             id=subscription_id,
             owner=owner,
-            version=int(params.get("old_version", 0)),
+            version=ser.int_field(params.get("old_version"), "old_version"),
             start_time=extents.start_time,
             end_time=extents.end_time,
             altitude_lo=(
@@ -241,9 +247,11 @@ class SCDService:
         vol4 = ser.volume4d_from_scd_json(aoi)
         try:
             cells = vol4.calculate_spatial_covering()
-        except geo_covering.AreaTooLargeError as e:
-            raise errors.area_too_large(str(e))
-        except (geo_covering.BadAreaError, ValueError) as e:
+        except (
+            geo_covering.AreaTooLargeError,
+            geo_covering.BadAreaError,
+            ValueError,
+        ) as e:
             raise _area_error(e)
         subs = self.store.search_subscriptions(cells, owner)
         return {"subscriptions": [ser.scd_sub_to_json(s) for s in subs]}

@@ -26,6 +26,45 @@ from dss_tpu.models.volumes import (
 TIME_FORMAT_RFC3339 = "RFC3339"
 
 
+def num(v, what: str, default: float = 0.0) -> float:
+    """Coerce an untrusted JSON scalar to float; 400 on garbage."""
+    if v is None:
+        v = default
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        raise errors.bad_request(f"bad {what}: {v!r}")
+
+
+def int_field(v, what: str, default: int = 0) -> int:
+    """Coerce an untrusted JSON scalar to int; 400 on garbage."""
+    if v is None:
+        v = default
+    try:
+        return int(v)
+    except (TypeError, ValueError):
+        raise errors.bad_request(f"bad {what}: {v!r}")
+
+
+def _dict_field(v, what: str) -> dict:
+    """Untrusted JSON object field: None -> {}, non-dict -> 400."""
+    if v is None:
+        return {}
+    if not isinstance(v, dict):
+        raise errors.bad_request(f"bad {what}: expected object")
+    return v
+
+
+def _list_field(v, what: str) -> list:
+    """Untrusted JSON array field: None -> [], non-list -> 400; every
+    element must be an object."""
+    if v is None:
+        return []
+    if not isinstance(v, list) or any(not isinstance(e, dict) for e in v):
+        raise errors.bad_request(f"bad {what}: expected array of objects")
+    return v
+
+
 def parse_time(s: str) -> datetime:
     """RFC3339 -> aware UTC datetime."""
     if not isinstance(s, str) or not s:
@@ -72,20 +111,22 @@ def volume4d_from_rid_json(d: dict) -> Volume4D:
     space = d.get("spatial_volume")
     if space is None:
         raise errors.bad_request("bad extents: missing required spatial_volume")
+    space = _dict_field(space, "spatial_volume")
     footprint = space.get("footprint")
     if footprint is None:
         raise errors.bad_request(
             "bad extents: spatial_volume missing required footprint"
         )
+    footprint = _dict_field(footprint, "footprint")
     vertices = [
-        LatLngPoint(lat=float(v.get("lat", 0.0)), lng=float(v.get("lng", 0.0)))
-        for v in footprint.get("vertices", [])
+        LatLngPoint(lat=num(v.get("lat"), "vertex lat"), lng=num(v.get("lng"), "vertex lng"))
+        for v in _list_field(footprint.get("vertices"), "vertices")
     ]
     result.spatial_volume = Volume3D(
         footprint=GeoPolygon(vertices=vertices),
         # proto3 scalars default to 0 when omitted (reference keeps them)
-        altitude_lo=float(space.get("altitude_lo", 0.0)),
-        altitude_hi=float(space.get("altitude_hi", 0.0)),
+        altitude_lo=num(space.get("altitude_lo"), "altitude_lo"),
+        altitude_hi=num(space.get("altitude_hi"), "altitude_hi"),
     )
     return result
 
@@ -159,8 +200,8 @@ def _altitude_value(d) -> Optional[float]:
     if d is None:
         return None
     if isinstance(d, dict):
-        return float(d.get("value", 0.0))
-    return float(d)
+        return num(d.get("value"), "altitude value")
+    return num(d, "altitude")
 
 
 def altitude_json(v: Optional[float]) -> Optional[dict]:
@@ -179,7 +220,7 @@ def volume4d_from_scd_json(d: dict) -> Volume4D:
         start_time=_scd_time(d.get("time_start")),
         end_time=_scd_time(d.get("time_end")),
     )
-    vol3 = d.get("volume") or {}
+    vol3 = _dict_field(d.get("volume"), "volume")
     polygon = vol3.get("outline_polygon")
     circle = vol3.get("outline_circle")
     if polygon is not None and circle is not None:
@@ -188,24 +229,29 @@ def volume4d_from_scd_json(d: dict) -> Volume4D:
         )
     footprint = None
     if polygon is not None:
+        polygon = _dict_field(polygon, "outline_polygon")
         footprint = GeoPolygon(
             vertices=[
                 LatLngPoint(
-                    lat=float(v.get("lat", 0.0)), lng=float(v.get("lng", 0.0))
+                    lat=num(v.get("lat"), "vertex lat"),
+                    lng=num(v.get("lng"), "vertex lng"),
                 )
-                for v in polygon.get("vertices", [])
+                for v in _list_field(polygon.get("vertices"), "vertices")
             ]
         )
     elif circle is not None:
-        center = circle.get("center") or {}
+        circle = _dict_field(circle, "outline_circle")
+        center = _dict_field(circle.get("center"), "circle center")
         radius = circle.get("radius") or {}
         units = radius.get("units", "M") if isinstance(radius, dict) else "M"
         factor = 1.0 if units == "M" else 0.0  # unknown units -> 0 (reference map)
         footprint = GeoCircle(
             center=LatLngPoint(
-                lat=float(center.get("lat", 0.0)), lng=float(center.get("lng", 0.0))
+                lat=num(center.get("lat"), "circle center lat"),
+                lng=num(center.get("lng"), "circle center lng"),
             ),
-            radius_meter=factor * float(radius.get("value", 0.0)),
+            radius_meter=factor
+            * num(radius.get("value") if isinstance(radius, dict) else radius, "circle radius"),
         )
     result.spatial_volume = Volume3D(
         footprint=footprint,

@@ -1,0 +1,121 @@
+"""Untrusted wire-input hardening: malformed JSON scalars must map to
+400 INVALID_ARGUMENT at the boundary, never raise bare ValueError/
+TypeError (-> 500) from inside the handlers."""
+
+import pytest
+
+from dss_tpu import errors
+from dss_tpu.clock import FakeClock
+from dss_tpu.dar.dss_store import DSSStore
+from dss_tpu.services.rid import RIDService
+from dss_tpu.services.scd import SCDService
+from dss_tpu.services import serialization as ser
+from tests.test_scd_service import OP1, scd_extent
+from tests.test_store_contract import T0
+
+
+@pytest.fixture
+def scd_svc():
+    clock = FakeClock(T0)
+    return SCDService(DSSStore(storage="memory", clock=clock).scd, clock)
+
+
+@pytest.fixture
+def rid_svc():
+    clock = FakeClock(T0)
+    return RIDService(DSSStore(storage="memory", clock=clock).rid, clock)
+
+
+def _expect_400(fn):
+    with pytest.raises(errors.StatusError) as exc:
+        fn()
+    assert exc.value.code == errors.Code.INVALID_ARGUMENT
+    return exc.value
+
+
+def test_scd_garbage_vertex_lat(scd_svc):
+    ext = scd_extent()
+    ext["volume"]["outline_polygon"]["vertices"][0]["lat"] = "abc"
+    _expect_400(
+        lambda: scd_svc.put_operation(
+            OP1, {"uss_base_url": "https://uss.example.com", "extents": [ext]}, "uss1"
+        )
+    )
+
+
+def test_scd_null_vertex_lat(scd_svc):
+    # proto3 JSON: null scalar == default 0.0 — must not crash with a
+    # bare TypeError; here lat=0 makes the footprint exceed 2500 km².
+    ext = scd_extent()
+    ext["volume"]["outline_polygon"]["vertices"][0]["lat"] = None
+    with pytest.raises(errors.StatusError):
+        scd_svc.put_operation(
+            OP1, {"uss_base_url": "https://uss.example.com", "extents": [ext]}, "uss1"
+        )
+
+
+def test_scd_garbage_altitude(scd_svc):
+    ext = scd_extent()
+    ext["volume"]["altitude_lower"] = {"value": {"nested": 1}}
+    _expect_400(
+        lambda: scd_svc.put_operation(
+            OP1, {"uss_base_url": "https://uss.example.com", "extents": [ext]}, "uss1"
+        )
+    )
+
+
+def test_scd_garbage_old_version(scd_svc):
+    ext = scd_extent()
+    _expect_400(
+        lambda: scd_svc.put_operation(
+            OP1,
+            {
+                "uss_base_url": "https://uss.example.com",
+                "extents": [ext],
+                "old_version": "one",
+            },
+            "uss1",
+        )
+    )
+
+
+def test_scd_garbage_circle(scd_svc):
+    ext = scd_extent()
+    del ext["volume"]["outline_polygon"]
+    ext["volume"]["outline_circle"] = {
+        "center": {"lat": [], "lng": 0},
+        "radius": {"value": 100, "units": "M"},
+    }
+    _expect_400(
+        lambda: scd_svc.put_operation(
+            OP1, {"uss_base_url": "https://uss.example.com", "extents": [ext]}, "uss1"
+        )
+    )
+
+
+def test_rid_garbage_search_times_are_400_not_500(rid_svc):
+    area = "40.0,-100.0,40.1,-100.0,40.1,-99.9,40.0,-99.9"
+    e = _expect_400(lambda: rid_svc.search_isas(area, earliest_time="garbage"))
+    assert "earliest_time" in e.message
+    e = _expect_400(lambda: rid_svc.search_isas(area, latest_time="2020-13-45"))
+    assert "latest_time" in e.message
+
+
+def test_rid_garbage_extents_vertex(rid_svc):
+    params = {
+        "extents": {
+            "spatial_volume": {
+                "footprint": {"vertices": [{"lat": "x", "lng": 0}]},
+                "altitude_lo": 0,
+                "altitude_hi": 100,
+            },
+            "time_start": ser.format_time(T0),
+            "time_end": ser.format_time(T0),
+        },
+        "flights_url": "https://uss.example.com/flights",
+    }
+    _expect_400(
+        lambda: rid_svc.create_isa(
+            "cccccccc-cccc-4ccc-8ccc-ccccccccccc1", params, "uss1"
+        )
+    )
