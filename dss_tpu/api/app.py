@@ -1,0 +1,395 @@
+"""The REST application: routes, auth enforcement, error mapping.
+
+Route surface mirrors the reference's REST bindings:
+  RID  (rid.proto:527-630):  /v1/dss/identification_service_areas,
+                             /v1/dss/subscriptions
+  SCD  (scd.proto:602-716):  /dss/v1/{operation_references,
+                             subscriptions, constraint_references,
+                             reports}
+  Aux  (aux_service.proto):  /aux/v1/validate_oauth
+  plus /healthy (cmds/http-gateway/main.go:82-90).
+
+Error mapping follows myCodeToHTTPStatus/myHTTPError
+(cmds/http-gateway/main.go:102-237): StatusError -> JSON
+{error, message, code}; MISSING_OVNS -> HTTP 409 whose body is the
+AirspaceConflictResponse itself; AREA_TOO_LARGE -> HTTP 413.
+
+Scope tables mirror pkg/rid/server/server.go:34-49,
+pkg/scd/server.go:58-76, pkg/aux_/server.go:17-21.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+import json
+from typing import Optional
+
+from aiohttp import web
+
+from dss_tpu import errors
+from dss_tpu.auth.authorizer import (
+    Authorizer,
+    require_all_scopes,
+    require_any_scope,
+)
+
+RID_READ = "dss.read.identification_service_areas"
+RID_WRITE = "dss.write.identification_service_areas"
+SCD_SC = "utm.strategic_coordination"
+SCD_CM = "utm.constraint_management"
+SCD_CC = "utm.constraint_consumption"
+
+_RID = "/ridpb.DiscoveryAndSynchronizationService/"
+_SCD = "/scdpb.UTMAPIUSSDSSAndUSSUSSService/"
+_AUX = "/auxpb.DSSAuxService/"
+
+RID_SCOPES = {
+    _RID + "CreateIdentificationServiceArea": require_all_scopes(RID_WRITE),
+    _RID + "UpdateIdentificationServiceArea": require_all_scopes(RID_WRITE),
+    _RID + "DeleteIdentificationServiceArea": require_all_scopes(RID_WRITE),
+    _RID + "GetIdentificationServiceArea": require_all_scopes(RID_READ),
+    _RID + "SearchIdentificationServiceAreas": require_all_scopes(RID_READ),
+    _RID + "CreateSubscription": require_all_scopes(RID_WRITE),
+    _RID + "UpdateSubscription": require_all_scopes(RID_WRITE),
+    _RID + "DeleteSubscription": require_all_scopes(RID_WRITE),
+    _RID + "GetSubscription": require_all_scopes(RID_READ),
+    _RID + "SearchSubscriptions": require_all_scopes(RID_READ),
+    _AUX + "ValidateOauth": require_all_scopes(RID_WRITE),
+}
+
+SCD_SCOPES = {
+    _SCD + "PutOperationReference": require_any_scope(SCD_SC),
+    _SCD + "GetOperationReference": require_any_scope(SCD_SC),
+    _SCD + "DeleteOperationReference": require_any_scope(SCD_SC),
+    _SCD + "SearchOperationReferences": require_any_scope(SCD_SC),
+    _SCD + "PutSubscription": require_any_scope(SCD_SC, SCD_CC),
+    _SCD + "GetSubscription": require_any_scope(SCD_SC, SCD_CC),
+    _SCD + "DeleteSubscription": require_any_scope(SCD_SC, SCD_CC),
+    _SCD + "QuerySubscriptions": require_any_scope(SCD_SC, SCD_CC),
+    _SCD + "PutConstraintReference": require_any_scope(SCD_CM),
+    _SCD + "GetConstraintReference": require_any_scope(SCD_SC, SCD_CC, SCD_CM),
+    _SCD + "DeleteConstraintReference": require_any_scope(SCD_CM),
+    _SCD + "QueryConstraintReferences": require_any_scope(
+        SCD_SC, SCD_CC, SCD_CM
+    ),
+    _SCD + "MakeDssReport": require_any_scope(SCD_SC, SCD_CC, SCD_CM),
+}
+
+
+def _error_response(e: errors.StatusError) -> web.Response:
+    if e.code == errors.Code.MISSING_OVNS:
+        # special 409 schema: the body IS the AirspaceConflictResponse
+        # (cmds/http-gateway/main.go:187-200)
+        body = e.details or {"message": e.message}
+        return web.json_response(body, status=e.http_status)
+    return web.json_response(
+        {"error": e.message, "message": e.message, "code": int(e.code)},
+        status=e.http_status,
+    )
+
+
+@web.middleware
+async def error_middleware(request, handler):
+    try:
+        return await handler(request)
+    except errors.StatusError as e:
+        return _error_response(e)
+    except web.HTTPException:
+        raise
+    except Exception as e:  # noqa: BLE001 — normalize to the error schema
+        return _error_response(errors.internal(str(e)))
+
+
+async def _call(fn, *args):
+    """Run a synchronous service call off the event loop.  The service
+    layer holds the store lock and may run multi-ms TPU kernels (first
+    call: a multi-second jit compile); keeping it off the loop lets
+    other requests (and /healthy) proceed — the goroutine-per-RPC
+    analog of grpc-go."""
+    loop = asyncio.get_running_loop()
+    return await loop.run_in_executor(None, functools.partial(fn, *args))
+
+
+async def _params(request) -> dict:
+    if request.method in ("GET", "DELETE"):
+        return {}
+    try:
+        body = await request.text()
+        params = json.loads(body) if body else {}
+    except ValueError as e:
+        raise errors.bad_request(f"malformed request body: {e}")
+    if not isinstance(params, dict):
+        raise errors.bad_request("request body must be a JSON object")
+    return params
+
+
+def build_app(
+    rid_service=None,
+    scd_service=None,
+    authorizer: Optional[Authorizer] = None,
+    *,
+    enable_scd: bool = True,
+) -> web.Application:
+    app = web.Application(middlewares=[error_middleware])
+
+    def auth(request, operation: str) -> str:
+        """-> owner.  No authorizer configured (unit harness) -> anon."""
+        if authorizer is None:
+            return "anonymous"
+        return authorizer.authorize(
+            request.headers.get("Authorization"), operation
+        )
+
+    # -- health (no auth) ----------------------------------------------------
+
+    async def healthy(request):
+        return web.Response(text="ok")
+
+    app.router.add_get("/healthy", healthy)
+
+    # -- aux -----------------------------------------------------------------
+
+    async def validate_oauth(request):
+        owner = auth(request, _AUX + "ValidateOauth")
+        want = request.query.get("owner", "")
+        if want and want != owner:
+            raise errors.permission_denied(
+                f"owner mismatch, required: {want}, "
+                f"but oauth token has {owner}"
+            )
+        return web.json_response({})
+
+    app.router.add_get("/aux/v1/validate_oauth", validate_oauth)
+
+    # -- RID -----------------------------------------------------------------
+
+    if rid_service is not None:
+        rid = rid_service
+
+        async def isa_create(request):
+            owner = auth(request, _RID + "CreateIdentificationServiceArea")
+            return web.json_response(
+                await _call(rid.create_isa, 
+                    request.match_info["id"], await _params(request), owner
+                )
+            )
+
+        async def isa_update(request):
+            owner = auth(request, _RID + "UpdateIdentificationServiceArea")
+            return web.json_response(
+                await _call(rid.update_isa, 
+                    request.match_info["id"],
+                    request.match_info["version"],
+                    await _params(request),
+                    owner,
+                )
+            )
+
+        async def isa_delete(request):
+            owner = auth(request, _RID + "DeleteIdentificationServiceArea")
+            return web.json_response(
+                await _call(rid.delete_isa, 
+                    request.match_info["id"],
+                    request.match_info["version"],
+                    owner,
+                )
+            )
+
+        async def isa_get(request):
+            auth(request, _RID + "GetIdentificationServiceArea")
+            return web.json_response(await _call(rid.get_isa, request.match_info["id"]))
+
+        async def isa_search(request):
+            auth(request, _RID + "SearchIdentificationServiceAreas")
+            return web.json_response(
+                await _call(rid.search_isas, 
+                    request.query.get("area", ""),
+                    request.query.get("earliest_time"),
+                    request.query.get("latest_time"),
+                )
+            )
+
+        async def sub_create(request):
+            owner = auth(request, _RID + "CreateSubscription")
+            return web.json_response(
+                await _call(rid.create_subscription, 
+                    request.match_info["id"], await _params(request), owner
+                )
+            )
+
+        async def sub_update(request):
+            owner = auth(request, _RID + "UpdateSubscription")
+            return web.json_response(
+                await _call(rid.update_subscription, 
+                    request.match_info["id"],
+                    request.match_info["version"],
+                    await _params(request),
+                    owner,
+                )
+            )
+
+        async def sub_delete(request):
+            owner = auth(request, _RID + "DeleteSubscription")
+            return web.json_response(
+                await _call(rid.delete_subscription, 
+                    request.match_info["id"],
+                    request.match_info["version"],
+                    owner,
+                )
+            )
+
+        async def sub_get(request):
+            auth(request, _RID + "GetSubscription")
+            return web.json_response(
+                await _call(rid.get_subscription, request.match_info["id"])
+            )
+
+        async def sub_search(request):
+            owner = auth(request, _RID + "SearchSubscriptions")
+            return web.json_response(
+                await _call(rid.search_subscriptions, request.query.get("area", ""), owner)
+            )
+
+        base = "/v1/dss/identification_service_areas"
+        app.router.add_put(base + "/{id}", isa_create)
+        app.router.add_put(base + "/{id}/{version}", isa_update)
+        app.router.add_delete(base + "/{id}/{version}", isa_delete)
+        app.router.add_get(base + "/{id}", isa_get)
+        app.router.add_get(base, isa_search)
+        sbase = "/v1/dss/subscriptions"
+        app.router.add_put(sbase + "/{id}", sub_create)
+        app.router.add_put(sbase + "/{id}/{version}", sub_update)
+        app.router.add_delete(sbase + "/{id}/{version}", sub_delete)
+        app.router.add_get(sbase + "/{id}", sub_get)
+        app.router.add_get(sbase, sub_search)
+
+    # -- SCD -----------------------------------------------------------------
+
+    if scd_service is not None and enable_scd:
+        scd = scd_service
+
+        async def op_put(request):
+            owner = auth(request, _SCD + "PutOperationReference")
+            return web.json_response(
+                await _call(scd.put_operation, 
+                    request.match_info["entityuuid"],
+                    await _params(request),
+                    owner,
+                )
+            )
+
+        async def op_get(request):
+            owner = auth(request, _SCD + "GetOperationReference")
+            return web.json_response(
+                await _call(scd.get_operation, request.match_info["entityuuid"], owner)
+            )
+
+        async def op_delete(request):
+            owner = auth(request, _SCD + "DeleteOperationReference")
+            return web.json_response(
+                await _call(scd.delete_operation, request.match_info["entityuuid"], owner)
+            )
+
+        async def op_query(request):
+            owner = auth(request, _SCD + "SearchOperationReferences")
+            return web.json_response(
+                await _call(scd.search_operations, await _params(request), owner)
+            )
+
+        async def scd_sub_put(request):
+            owner = auth(request, _SCD + "PutSubscription")
+            return web.json_response(
+                await _call(scd.put_subscription, 
+                    request.match_info["subscriptionid"],
+                    await _params(request),
+                    owner,
+                )
+            )
+
+        async def scd_sub_get(request):
+            owner = auth(request, _SCD + "GetSubscription")
+            return web.json_response(
+                await _call(scd.get_subscription, 
+                    request.match_info["subscriptionid"], owner
+                )
+            )
+
+        async def scd_sub_delete(request):
+            owner = auth(request, _SCD + "DeleteSubscription")
+            return web.json_response(
+                await _call(scd.delete_subscription, 
+                    request.match_info["subscriptionid"], owner
+                )
+            )
+
+        async def scd_sub_query(request):
+            owner = auth(request, _SCD + "QuerySubscriptions")
+            return web.json_response(
+                await _call(scd.query_subscriptions, await _params(request), owner)
+            )
+
+        async def constraint_put(request):
+            auth(request, _SCD + "PutConstraintReference")
+            return web.json_response(
+                await _call(scd.put_constraint, 
+                    request.match_info["entityuuid"], await _params(request)
+                )
+            )
+
+        async def constraint_get(request):
+            auth(request, _SCD + "GetConstraintReference")
+            return web.json_response(
+                await _call(scd.get_constraint, request.match_info["entityuuid"])
+            )
+
+        async def constraint_delete(request):
+            auth(request, _SCD + "DeleteConstraintReference")
+            return web.json_response(
+                await _call(scd.delete_constraint, request.match_info["entityuuid"])
+            )
+
+        async def constraint_query(request):
+            auth(request, _SCD + "QueryConstraintReferences")
+            return web.json_response(
+                await _call(scd.query_constraints, await _params(request))
+            )
+
+        async def dss_report(request):
+            auth(request, _SCD + "MakeDssReport")
+            return web.json_response(
+                await _call(scd.make_dss_report, await _params(request))
+            )
+
+        # exact /query routes registered before the {entityuuid} patterns
+        app.router.add_post("/dss/v1/operation_references/query", op_query)
+        app.router.add_post("/dss/v1/subscriptions/query", scd_sub_query)
+        app.router.add_post(
+            "/dss/v1/constraint_references/query", constraint_query
+        )
+        app.router.add_post("/dss/v1/reports", dss_report)
+        app.router.add_put("/dss/v1/operation_references/{entityuuid}", op_put)
+        app.router.add_get("/dss/v1/operation_references/{entityuuid}", op_get)
+        app.router.add_delete(
+            "/dss/v1/operation_references/{entityuuid}", op_delete
+        )
+        app.router.add_put(
+            "/dss/v1/subscriptions/{subscriptionid}", scd_sub_put
+        )
+        app.router.add_get(
+            "/dss/v1/subscriptions/{subscriptionid}", scd_sub_get
+        )
+        app.router.add_delete(
+            "/dss/v1/subscriptions/{subscriptionid}", scd_sub_delete
+        )
+        app.router.add_put(
+            "/dss/v1/constraint_references/{entityuuid}", constraint_put
+        )
+        app.router.add_get(
+            "/dss/v1/constraint_references/{entityuuid}", constraint_get
+        )
+        app.router.add_delete(
+            "/dss/v1/constraint_references/{entityuuid}", constraint_delete
+        )
+
+    return app

@@ -1,0 +1,249 @@
+"""Request authorization: key resolution + claims validation + scopes.
+
+Mirrors pkg/auth: key resolvers from PEM files or a JWKS endpoint with
+periodic refresh (auth.go:73-149, 258-277), token verification against
+every cached key (auth.go:303-317), claims rules (claims.go:43-60:
+non-empty sub, exp <= 1h out, non-empty iss), audience check
+(auth.go:319-322), and per-operation scope validators
+(RequireAllScopes/RequireAnyScope, auth.go:151-218).  Invalid token ->
+UNAUTHENTICATED; missing scopes -> PERMISSION_DENIED.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Iterable, List, Optional
+
+from dss_tpu import errors
+from dss_tpu.auth import jwt as jwtlib
+
+MAX_TOKEN_LIFETIME_S = 3600  # claims.go:49-52
+
+
+# -- scope validators --------------------------------------------------------
+
+
+class ScopesValidator:
+    def validate(self, scopes: set) -> None:
+        raise NotImplementedError
+
+
+class _RequireAll(ScopesValidator):
+    def __init__(self, required: Iterable[str]):
+        self.required = set(required)
+
+    def validate(self, scopes: set) -> None:
+        missing = self.required - scopes
+        if missing:
+            raise errors.permission_denied(
+                "missing required scopes: " + ", ".join(sorted(missing))
+            )
+
+
+class _RequireAny(ScopesValidator):
+    def __init__(self, accepted: Iterable[str]):
+        self.accepted = set(accepted)
+
+    def validate(self, scopes: set) -> None:
+        if not (self.accepted & scopes):
+            raise errors.permission_denied(
+                "missing any of required scopes: "
+                + ", ".join(sorted(self.accepted))
+            )
+
+
+def require_all_scopes(*scopes: str) -> ScopesValidator:
+    return _RequireAll(scopes)
+
+
+def require_any_scope(*scopes: str) -> ScopesValidator:
+    return _RequireAny(scopes)
+
+
+# -- key resolvers -----------------------------------------------------------
+
+
+class StaticKeyResolver:
+    """Fixed public keys from PEM blobs/files (auth.go FromFileKeyResolver)."""
+
+    def __init__(self, pems: List[bytes]):
+        self._keys = [jwtlib.load_public_key(p) for p in pems]
+
+    @classmethod
+    def from_files(cls, paths: List[str]) -> "StaticKeyResolver":
+        pems = []
+        for p in paths:
+            with open(p, "rb") as f:
+                pems.append(f.read())
+        return cls(pems)
+
+    def resolve(self) -> list:
+        return list(self._keys)
+
+
+def _jwk_to_public_key(jwk: dict):
+    """RSA JWK {n, e} -> public key object."""
+    from cryptography.hazmat.primitives.asymmetric import rsa as _rsa
+
+    def u64(s):
+        return int.from_bytes(jwtlib._b64url_decode(s), "big")
+
+    return _rsa.RSAPublicNumbers(u64(jwk["e"]), u64(jwk["n"])).public_key()
+
+
+class JWKSResolver:
+    """Public keys from a JWKS document (auth.go JWKSResolver).
+
+    `fetch` is injectable (no-egress tests use a canned document);
+    the default fetcher GETs the endpoint with urllib.
+    """
+
+    def __init__(
+        self,
+        endpoint: str,
+        key_ids: Optional[List[str]] = None,
+        fetch: Optional[Callable[[str], dict]] = None,
+    ):
+        self.endpoint = endpoint
+        self.key_ids = set(key_ids) if key_ids else None
+        self._fetch = fetch or self._default_fetch
+    @staticmethod
+    def _default_fetch(endpoint: str) -> dict:
+        import json
+        import urllib.request
+
+        with urllib.request.urlopen(endpoint, timeout=10) as r:
+            return json.loads(r.read())
+
+    def resolve(self) -> list:
+        doc = self._fetch(self.endpoint)
+        keys = []
+        for jwk in doc.get("keys", []):
+            if jwk.get("kty") != "RSA":
+                continue
+            if self.key_ids is not None and jwk.get("kid") not in self.key_ids:
+                continue
+            keys.append(_jwk_to_public_key(jwk))
+        if not keys:
+            raise ValueError(f"no usable RSA keys in JWKS from {self.endpoint}")
+        return keys
+
+
+# -- authorizer --------------------------------------------------------------
+
+
+class Authorizer:
+    """Validates bearer tokens and enforces per-operation scopes.
+
+    scopes_table maps operation name (the reference's full RPC method
+    name, e.g. "/ridpb.DiscoveryAndSynchronizationService/
+    CreateIdentificationServiceArea") to a ScopesValidator.  Operations
+    absent from the table require only a valid token (reference
+    behavior: missing validator -> no scope check, auth.go:333-339).
+    """
+
+    def __init__(
+        self,
+        resolver,
+        audiences: List[str],
+        scopes_table: Optional[Dict[str, ScopesValidator]] = None,
+        *,
+        refresh_interval_s: Optional[float] = None,
+        now: Callable[[], float] = time.time,
+    ):
+        self._resolver = resolver
+        self.audiences = list(audiences)
+        self.scopes_table = dict(scopes_table or {})
+        self.now = now
+        self._lock = threading.RLock()
+        self._keys = resolver.resolve()
+        self._stop = threading.Event()
+        self._refresher = None
+        if refresh_interval_s:
+            self._refresher = threading.Thread(
+                target=self._refresh_loop,
+                args=(refresh_interval_s,),
+                daemon=True,
+            )
+            self._refresher.start()
+
+    def close(self):
+        self._stop.set()
+
+    def _refresh_loop(self, interval: float):
+        # key hot-swap goroutine analog (auth.go:258-277)
+        while not self._stop.wait(interval):
+            try:
+                keys = self._resolver.resolve()
+                with self._lock:
+                    self._keys = keys
+            except Exception:
+                pass  # keep serving the previous keys
+
+    def refresh_keys(self):
+        keys = self._resolver.resolve()
+        with self._lock:
+            self._keys = keys
+
+    # -- the per-request path ------------------------------------------------
+
+    def _verify_signature(self, token: str) -> dict:
+        with self._lock:
+            keys = list(self._keys)
+        last = None
+        for key in keys:
+            try:
+                return jwtlib.verify_rs256(token, key)
+            except jwtlib.JWTError as e:
+                last = e
+        raise errors.unauthenticated(f"invalid token: {last}")
+
+    def _validate_claims(self, payload: dict) -> None:
+        if not payload.get("sub"):
+            raise errors.unauthenticated("missing or empty subject")
+        exp = payload.get("exp")
+        if exp is None:
+            raise errors.unauthenticated("missing token expiry")
+        now = self.now()
+        try:
+            exp = float(exp)
+        except (TypeError, ValueError):
+            raise errors.unauthenticated("bad token expiry")
+        if exp < now:
+            raise errors.unauthenticated("token is expired")
+        if exp > now + MAX_TOKEN_LIFETIME_S:
+            raise errors.unauthenticated(
+                "token expiration time is too far in the future, "
+                "max token duration is 1 hour"
+            )
+        if not payload.get("iss"):
+            raise errors.unauthenticated("missing Issuer URI")
+        aud = payload.get("aud", "")
+        if aud not in self.audiences:
+            raise errors.unauthenticated(
+                f'invalid token audience: "{aud}"'
+            )
+
+    @staticmethod
+    def scopes_of(payload: dict) -> set:
+        raw = payload.get("scope", "")
+        if isinstance(raw, str):
+            return {s for s in raw.split(" ") if s}
+        if isinstance(raw, list):
+            return set(raw)
+        return set()
+
+    def authorize(self, authorization_header: Optional[str], operation: str) -> str:
+        """-> owner (the `sub` claim).  Raises StatusError on failure."""
+        if not authorization_header:
+            raise errors.unauthenticated("missing token")
+        parts = authorization_header.split(" ")
+        if len(parts) != 2 or parts[0].lower() != "bearer":
+            raise errors.unauthenticated("missing or malformed bearer token")
+        payload = self._verify_signature(parts[1])
+        self._validate_claims(payload)
+        validator = self.scopes_table.get(operation)
+        if validator is not None:
+            validator.validate(self.scopes_of(payload))
+        return str(payload["sub"])

@@ -1,0 +1,3 @@
+"""Binaries: the DSS server and the dummy OAuth token minter
+(analogs of cmds/grpc-backend + cmds/http-gateway and
+cmds/dummy-oauth)."""

@@ -1,0 +1,125 @@
+"""The DSS server binary: flags, store bootstrap, auth setup, serve.
+
+Collapses the reference's two processes (cmds/grpc-backend
+RunGRPCServer, main.go:90-222 + cmds/http-gateway RunHTTPProxy) into
+one REST server; the flag inventory mirrors grpc-backend main.go:42-73.
+
+Run: python -m dss_tpu.cmds.server --addr :8082 --enable_scd \
+         --public_key_files build/test-certs/oauth.pem \
+         --accepted_jwt_audiences localhost --storage tpu
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from aiohttp import web
+
+from dss_tpu.api.app import RID_SCOPES, SCD_SCOPES, build_app
+from dss_tpu.auth.authorizer import (
+    Authorizer,
+    JWKSResolver,
+    StaticKeyResolver,
+)
+from dss_tpu.clock import Clock
+from dss_tpu.dar.dss_store import DSSStore
+from dss_tpu.services.rid import RIDService
+from dss_tpu.services.scd import SCDService
+
+
+def make_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="TPU-native DSS server")
+    p.add_argument("--addr", default=":8082", help="address to listen on")
+    p.add_argument(
+        "--storage",
+        default="tpu",
+        choices=["memory", "tpu"],
+        help="spatial index backend (memory = host linear scan)",
+    )
+    p.add_argument(
+        "--wal_path", default="", help="write-ahead log file (durability)"
+    )
+    p.add_argument("--wal_fsync", action="store_true")
+    p.add_argument("--enable_scd", action="store_true")
+    p.add_argument(
+        "--public_key_files",
+        default="",
+        help="comma-separated PEM files with JWT verification keys",
+    )
+    p.add_argument("--jwks_endpoint", default="")
+    p.add_argument("--jwks_key_ids", default="")
+    p.add_argument(
+        "--key_refresh_timer",
+        type=float,
+        default=0.0,
+        help="seconds between JWKS refreshes (0 = no refresh)",
+    )
+    p.add_argument(
+        "--accepted_jwt_audiences",
+        default="",
+        help="comma-separated accepted `aud` claims",
+    )
+    p.add_argument(
+        "--insecure_no_auth",
+        action="store_true",
+        help="disable auth entirely (local testing only)",
+    )
+    return p
+
+
+def build(args) -> web.Application:
+    clock = Clock()
+    store = DSSStore(
+        storage=args.storage,
+        clock=clock,
+        wal_path=args.wal_path or None,
+        wal_fsync=args.wal_fsync,
+    )
+    rid = RIDService(store.rid, clock)
+    scd = SCDService(store.scd, clock) if args.enable_scd else None
+
+    authorizer = None
+    if not args.insecure_no_auth:
+        if args.public_key_files:
+            resolver = StaticKeyResolver.from_files(
+                [f for f in args.public_key_files.split(",") if f]
+            )
+        elif args.jwks_endpoint:
+            resolver = JWKSResolver(
+                args.jwks_endpoint,
+                [k for k in args.jwks_key_ids.split(",") if k] or None,
+            )
+        else:
+            raise SystemExit(
+                "one of --public_key_files / --jwks_endpoint is required "
+                "(or --insecure_no_auth)"
+            )
+        audiences = [a for a in args.accepted_jwt_audiences.split(",") if a]
+        if not audiences:
+            raise SystemExit(
+                "--accepted_jwt_audiences is required when auth is enabled "
+                "(every token would be rejected otherwise)"
+            )
+        scopes = dict(RID_SCOPES)
+        scopes.update(SCD_SCOPES)
+        authorizer = Authorizer(
+            resolver,
+            audiences=audiences,
+            scopes_table=scopes,
+            refresh_interval_s=args.key_refresh_timer or None,
+        )
+
+    return build_app(
+        rid, scd, authorizer, enable_scd=args.enable_scd
+    )
+
+
+def main():
+    args = make_parser().parse_args()
+    app = build(args)
+    host, _, port = args.addr.rpartition(":")
+    web.run_app(app, host=host or "0.0.0.0", port=int(port))
+
+
+if __name__ == "__main__":
+    main()

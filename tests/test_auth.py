@@ -1,0 +1,185 @@
+"""Auth tests: JWT sign/verify, claims rules, scope validators, key
+resolution — mirroring pkg/auth/auth_test.go + claims.go semantics."""
+
+import time
+
+import pytest
+from cryptography.hazmat.primitives import serialization
+from cryptography.hazmat.primitives.asymmetric import rsa
+
+from dss_tpu import errors
+from dss_tpu.auth import jwt as jwtlib
+from dss_tpu.auth.authorizer import (
+    Authorizer,
+    JWKSResolver,
+    StaticKeyResolver,
+    require_all_scopes,
+    require_any_scope,
+)
+
+NOW = 1_700_000_000.0
+
+
+@pytest.fixture(scope="module")
+def keypair():
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    priv = key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption(),
+    )
+    pub = key.public_key().public_bytes(
+        serialization.Encoding.PEM,
+        serialization.PublicFormat.SubjectPublicKeyInfo,
+    )
+    return priv, pub
+
+
+def claims(**kw):
+    c = {
+        "sub": "uss1",
+        "aud": "dss.example.com",
+        "iss": "dummy-oauth",
+        "exp": NOW + 1800,
+        "scope": "dss.read.identification_service_areas",
+    }
+    c.update(kw)
+    return c
+
+
+def make_authorizer(pub, scopes_table=None, audiences=None):
+    return Authorizer(
+        StaticKeyResolver([pub]),
+        audiences=audiences or ["dss.example.com"],
+        scopes_table=scopes_table,
+        now=lambda: NOW,
+    )
+
+
+def test_round_trip(keypair):
+    priv, pub = keypair
+    tok = jwtlib.sign_rs256(claims(), priv)
+    payload = jwtlib.verify_rs256(tok, pub)
+    assert payload["sub"] == "uss1"
+
+
+def test_tampered_token_rejected(keypair):
+    priv, pub = keypair
+    tok = jwtlib.sign_rs256(claims(), priv)
+    h, p, s = tok.split(".")
+    import base64, json
+
+    body = json.loads(base64.urlsafe_b64decode(p + "=="))
+    body["sub"] = "attacker"
+    p2 = base64.urlsafe_b64encode(
+        json.dumps(body).encode()
+    ).rstrip(b"=").decode()
+    with pytest.raises(jwtlib.JWTError):
+        jwtlib.verify_rs256(f"{h}.{p2}.{s}", pub)
+
+
+def test_wrong_key_rejected(keypair):
+    priv, _ = keypair
+    other = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    pub2 = other.public_key().public_bytes(
+        serialization.Encoding.PEM,
+        serialization.PublicFormat.SubjectPublicKeyInfo,
+    )
+    tok = jwtlib.sign_rs256(claims(), priv)
+    with pytest.raises(jwtlib.JWTError):
+        jwtlib.verify_rs256(tok, pub2)
+
+
+def _auth_code(authz, tok, op="/x/Y"):
+    with pytest.raises(errors.StatusError) as e:
+        authz.authorize(f"Bearer {tok}", op)
+    return e.value.code
+
+
+def test_claims_rules(keypair):
+    priv, pub = keypair
+    a = make_authorizer(pub)
+    # valid
+    assert a.authorize(f"Bearer {jwtlib.sign_rs256(claims(), priv)}", "/x/Y") == "uss1"
+    # missing sub
+    assert _auth_code(a, jwtlib.sign_rs256(claims(sub=""), priv)) == errors.Code.UNAUTHENTICATED
+    # expired
+    assert _auth_code(a, jwtlib.sign_rs256(claims(exp=NOW - 10), priv)) == errors.Code.UNAUTHENTICATED
+    # expiry too far out (> 1h, claims.go:49-52)
+    assert _auth_code(a, jwtlib.sign_rs256(claims(exp=NOW + 7200), priv)) == errors.Code.UNAUTHENTICATED
+    # missing issuer
+    assert _auth_code(a, jwtlib.sign_rs256(claims(iss=""), priv)) == errors.Code.UNAUTHENTICATED
+    # wrong audience
+    assert _auth_code(a, jwtlib.sign_rs256(claims(aud="evil"), priv)) == errors.Code.UNAUTHENTICATED
+    # garbage tokens
+    assert _auth_code(a, "not.a.jwt") == errors.Code.UNAUTHENTICATED
+    with pytest.raises(errors.StatusError):
+        a.authorize(None, "/x/Y")
+    with pytest.raises(errors.StatusError):
+        a.authorize("Basic zzz", "/x/Y")
+
+
+def test_scope_enforcement(keypair):
+    priv, pub = keypair
+    table = {
+        "/svc/Write": require_all_scopes("w1", "w2"),
+        "/svc/Read": require_any_scope("r1", "r2"),
+    }
+    a = make_authorizer(pub, scopes_table=table)
+    t_all = jwtlib.sign_rs256(claims(scope="w1 w2 extra"), priv)
+    t_partial = jwtlib.sign_rs256(claims(scope="w1"), priv)
+    t_r2 = jwtlib.sign_rs256(claims(scope="r2"), priv)
+    assert a.authorize(f"Bearer {t_all}", "/svc/Write") == "uss1"
+    assert _auth_code(a, t_partial, "/svc/Write") == errors.Code.PERMISSION_DENIED
+    assert a.authorize(f"Bearer {t_r2}", "/svc/Read") == "uss1"
+    assert _auth_code(a, t_partial, "/svc/Read") == errors.Code.PERMISSION_DENIED
+    # op not in table: token validity only
+    assert a.authorize(f"Bearer {t_partial}", "/svc/Unlisted") == "uss1"
+
+
+def test_jwks_resolver(keypair):
+    priv, pub = keypair
+    key = jwtlib.load_public_key(pub)
+    import base64
+
+    def b64(i, n):
+        return base64.urlsafe_b64encode(
+            i.to_bytes(n, "big")
+        ).rstrip(b"=").decode()
+
+    nums = key.public_numbers()
+    doc = {
+        "keys": [
+            {
+                "kty": "RSA",
+                "kid": "k1",
+                "n": b64(nums.n, 256),
+                "e": b64(nums.e, 3),
+            },
+            {"kty": "EC", "kid": "skip-me"},
+        ]
+    }
+    resolver = JWKSResolver("https://jwks.example/keys", ["k1"], fetch=lambda ep: doc)
+    a = Authorizer(
+        resolver, audiences=["dss.example.com"], now=lambda: NOW
+    )
+    tok = jwtlib.sign_rs256(claims(), priv)
+    assert a.authorize(f"Bearer {tok}", "/x/Y") == "uss1"
+
+
+def test_key_rotation(keypair):
+    priv, pub = keypair
+    docs = [{"keys": []}]
+
+    other = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    other_pub = other.public_key().public_bytes(
+        serialization.Encoding.PEM,
+        serialization.PublicFormat.SubjectPublicKeyInfo,
+    )
+    a = make_authorizer(other_pub)
+    tok = jwtlib.sign_rs256(claims(), priv)
+    assert _auth_code(a, tok) == errors.Code.UNAUTHENTICATED
+    # hot-swap to the right key (the refresh goroutine analog)
+    a._resolver = StaticKeyResolver([pub])
+    a.refresh_keys()
+    assert a.authorize(f"Bearer {tok}", "/x/Y") == "uss1"

@@ -1,0 +1,365 @@
+"""End-to-end HTTP tests: dummy-oauth token -> REST routes -> services
+-> DAR store, over a live aiohttp server on a real socket (the
+docker_e2e.sh/prober analog, monitoring/prober/{rid,scd}).  Auth
+enforced on every route."""
+
+import asyncio
+import threading
+import time
+
+import pytest
+import requests
+from aiohttp import web
+from cryptography.hazmat.primitives import serialization
+from cryptography.hazmat.primitives.asymmetric import rsa
+
+from dss_tpu.api.app import RID_SCOPES, SCD_SCOPES, build_app
+from dss_tpu.auth.authorizer import Authorizer, StaticKeyResolver
+from dss_tpu.clock import Clock
+from dss_tpu.cmds.dummy_oauth import mint_token
+from dss_tpu.dar.dss_store import DSSStore
+from dss_tpu.services.rid import RIDService
+from dss_tpu.services.scd import SCDService
+
+
+class LiveServer:
+    """Runs an aiohttp app on 127.0.0.1:<ephemeral> in a daemon thread."""
+
+    def __init__(self, app: web.Application):
+        self.app = app
+        self.loop = asyncio.new_event_loop()
+        self.port = None
+        self._started = threading.Event()
+        self.thread = threading.Thread(target=self._run, daemon=True)
+        self.thread.start()
+        if not self._started.wait(30):
+            raise RuntimeError("server failed to start")
+        self.base = f"http://127.0.0.1:{self.port}"
+
+    def _run(self):
+        asyncio.set_event_loop(self.loop)
+        runner = web.AppRunner(self.app)
+        self.loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        self.loop.run_until_complete(site.start())
+        self.port = site._server.sockets[0].getsockname()[1]
+        self._started.set()
+        self.loop.run_forever()
+
+    def stop(self):
+        self.loop.call_soon_threadsafe(self.loop.stop)
+        self.thread.join(timeout=10)
+
+AUD = "dss.example.com"
+ISA1 = "dddddddd-dddd-4ddd-8ddd-ddddddddddd1"
+SUB1 = "dddddddd-dddd-4ddd-8ddd-ddddddddddd2"
+OP1 = "dddddddd-dddd-4ddd-8ddd-ddddddddddd3"
+OP2 = "dddddddd-dddd-4ddd-8ddd-ddddddddddd4"
+
+RID_SCOPE_STR = (
+    "dss.read.identification_service_areas "
+    "dss.write.identification_service_areas"
+)
+SCD_SCOPE_STR = "utm.strategic_coordination"
+
+
+@pytest.fixture(scope="module")
+def keypair():
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    priv = key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption(),
+    )
+    pub = key.public_key().public_bytes(
+        serialization.Encoding.PEM,
+        serialization.PublicFormat.SubjectPublicKeyInfo,
+    )
+    return priv, pub
+
+
+@pytest.fixture(scope="module")
+def server(keypair):
+    priv, pub = keypair
+    clock = Clock()
+    store = DSSStore(storage="tpu", clock=clock)
+    scopes = dict(RID_SCOPES)
+    scopes.update(SCD_SCOPES)
+    authorizer = Authorizer(
+        StaticKeyResolver([pub]), audiences=[AUD], scopes_table=scopes
+    )
+    app = build_app(
+        RIDService(store.rid, clock),
+        SCDService(store.scd, clock),
+        authorizer,
+        enable_scd=True,
+    )
+    srv = LiveServer(app)
+    yield srv
+    srv.stop()
+
+
+class Client:
+    """requests wrapper mimicking the aiohttp test-client call shape."""
+
+    def __init__(self, base):
+        self.base = base
+
+    def _do(self, method, path, **kw):
+        return requests.request(method, self.base + path, timeout=30, **kw)
+
+    def get(self, path, **kw):
+        return self._do("GET", path, **kw)
+
+    def put(self, path, **kw):
+        return self._do("PUT", path, **kw)
+
+    def post(self, path, **kw):
+        return self._do("POST", path, **kw)
+
+    def delete(self, path, **kw):
+        return self._do("DELETE", path, **kw)
+
+
+@pytest.fixture(scope="module")
+def client(server):
+    return Client(server.base)
+
+
+def token(keypair, scope, sub="uss1", **kw):
+    priv, _ = keypair
+    return mint_token(
+        priv,
+        scope=scope,
+        intended_audience=AUD,
+        issuer="dummy-oauth",
+        sub=sub,
+        **kw,
+    )
+
+
+def hdr(keypair, scope=RID_SCOPE_STR, sub="uss1", **kw):
+    return {"Authorization": f"Bearer {token(keypair, scope, sub, **kw)}"}
+
+
+def now_iso(offset_s=0):
+    t = time.time() + offset_s
+    return (
+        time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime(t)) + "Z"
+    )
+
+
+def isa_params(t0=60, t1=3600):
+    return {
+        "extents": {
+            "spatial_volume": {
+                "footprint": {
+                    "vertices": [
+                        {"lat": 40.0, "lng": -100.0},
+                        {"lat": 40.02, "lng": -100.0},
+                        {"lat": 40.02, "lng": -99.98},
+                        {"lat": 40.0, "lng": -99.98},
+                    ]
+                },
+                "altitude_lo": 20.0,
+                "altitude_hi": 400.0,
+            },
+            "time_start": now_iso(t0),
+            "time_end": now_iso(t1),
+        },
+        "flights_url": "https://uss1.example.com/flights",
+    }
+
+
+def scd_extent(t0=60, t1=3600):
+    return {
+        "volume": {
+            "outline_polygon": {
+                "vertices": [
+                    {"lat": 40.0, "lng": -100.0},
+                    {"lat": 40.02, "lng": -100.0},
+                    {"lat": 40.02, "lng": -99.98},
+                    {"lat": 40.0, "lng": -99.98},
+                ]
+            },
+            "altitude_lower": {"value": 50.0, "reference": "W84", "units": "M"},
+            "altitude_upper": {"value": 200.0, "reference": "W84", "units": "M"},
+        },
+        "time_start": {"value": now_iso(t0), "format": "RFC3339"},
+        "time_end": {"value": now_iso(t1), "format": "RFC3339"},
+    }
+
+
+def test_healthy_no_auth(client):
+    r = client.get("/healthy")
+    assert r.status_code == 200
+
+
+def test_missing_token_is_401(client):
+    r = client.get(f"/v1/dss/identification_service_areas/{ISA1}")
+    assert r.status_code == 401
+    body = r.json()
+    assert body["code"] == 16
+
+
+def test_wrong_scope_is_403(client, keypair):
+    r = client.put(
+        f"/v1/dss/identification_service_areas/{ISA1}",
+        json=isa_params(),
+        headers=hdr(keypair, scope="utm.strategic_coordination"),
+    )
+    assert r.status_code == 403
+
+
+def test_expired_token_is_401(client, keypair):
+    r = client.get(
+        "/v1/dss/identification_service_areas?area=40,-100,40.1,-100,40.1,-99.9",
+        headers=hdr(keypair, expire=int(time.time()) - 10),
+    )
+    assert r.status_code == 401
+
+
+def test_isa_crud_and_search(client, keypair):
+    h = hdr(keypair)
+    r = client.put(
+        f"/v1/dss/identification_service_areas/{ISA1}",
+        json=isa_params(),
+        headers=h,
+    )
+    assert r.status_code == 200, r.text
+    body = r.json()
+    version = body["service_area"]["version"]
+    assert body["service_area"]["id"] == ISA1
+
+    r = client.get(
+        f"/v1/dss/identification_service_areas/{ISA1}", headers=h
+    )
+    assert r.status_code == 200
+
+    area = "40.0,-100.0,40.02,-100.0,40.02,-99.98,40.0,-99.98"
+    r = client.get(
+        f"/v1/dss/identification_service_areas?area={area}", headers=h
+    )
+    assert r.status_code == 200
+    found = [s["id"] for s in (r.json())["service_areas"]]
+    assert ISA1 in found
+
+    # update with stale version -> 409
+    r = client.put(
+        f"/v1/dss/identification_service_areas/{ISA1}/badversion",
+        json=isa_params(),
+        headers=h,
+    )
+    assert r.status_code == 409
+
+    r = client.delete(
+        f"/v1/dss/identification_service_areas/{ISA1}/{version}", headers=h
+    )
+    assert r.status_code == 200
+
+
+def test_isa_area_too_large_is_413(client, keypair):
+    p = isa_params()
+    p["extents"]["spatial_volume"]["footprint"]["vertices"] = [
+        {"lat": 30.0, "lng": -110.0},
+        {"lat": 45.0, "lng": -110.0},
+        {"lat": 45.0, "lng": -90.0},
+        {"lat": 30.0, "lng": -90.0},
+    ]
+    r = client.put(
+        f"/v1/dss/identification_service_areas/{ISA1}",
+        json=p,
+        headers=hdr(keypair),
+    )
+    assert r.status_code == 413
+
+
+def test_malformed_body_is_400(client, keypair):
+    r = client.put(
+        f"/v1/dss/identification_service_areas/{ISA1}",
+        data=b"{not json",
+        headers=hdr(keypair),
+    )
+    assert r.status_code == 400
+
+
+def test_scd_conflict_flow_409_airspace_conflict(client, keypair):
+    h1 = hdr(keypair, scope=SCD_SCOPE_STR, sub="uss1")
+    h2 = hdr(keypair, scope=SCD_SCOPE_STR, sub="uss2")
+    r = client.put(
+        f"/dss/v1/operation_references/{OP1}",
+        json={
+            "extents": [scd_extent()],
+            "uss_base_url": "https://uss1.example.com",
+            "state": "Accepted",
+            "new_subscription": {"uss_base_url": "https://uss1.example.com"},
+        },
+        headers=h1,
+    )
+    assert r.status_code == 200, r.text
+    ovn = (r.json())["operation_reference"]["ovn"]
+
+    # second USS, overlapping, no key -> 409 with AirspaceConflictResponse
+    r = client.put(
+        f"/dss/v1/operation_references/{OP2}",
+        json={
+            "extents": [scd_extent()],
+            "uss_base_url": "https://uss2.example.com",
+            "state": "Accepted",
+            "new_subscription": {"uss_base_url": "https://uss2.example.com"},
+        },
+        headers=h2,
+    )
+    assert r.status_code == 409
+    body = r.json()
+    conflicts = body["entity_conflicts"]
+    assert [
+        c["operation_reference"]["id"] for c in conflicts
+    ] == [OP1]
+    # the conflicting op's OVN is disclosed so uss2 can build its key
+    assert conflicts[0]["operation_reference"]["ovn"] == ovn
+
+    # retry with the key -> success
+    r = client.put(
+        f"/dss/v1/operation_references/{OP2}",
+        json={
+            "extents": [scd_extent()],
+            "uss_base_url": "https://uss2.example.com",
+            "state": "Accepted",
+            "key": [ovn],
+            "new_subscription": {"uss_base_url": "https://uss2.example.com"},
+        },
+        headers=h2,
+    )
+    assert r.status_code == 200, r.text
+
+    # query ops in the area
+    r = client.post(
+        "/dss/v1/operation_references/query",
+        json={"area_of_interest": scd_extent()},
+        headers=h1,
+    )
+    assert r.status_code == 200
+    ids = {o["id"] for o in (r.json())["operation_references"]}
+    assert {OP1, OP2} <= ids
+
+
+def test_scd_constraints_unimplemented(client, keypair):
+    # reference: BadRequest("not yet implemented") -> 400
+    # (constraints_handler.go:12-30)
+    h = hdr(keypair, scope="utm.constraint_management")
+    r = client.put(
+        f"/dss/v1/constraint_references/{OP1}", json={}, headers=h
+    )
+    assert r.status_code == 400
+    assert "not yet implemented" in r.json()["message"]
+
+
+def test_aux_validate_oauth(client, keypair):
+    h = hdr(keypair)
+    r = client.get("/aux/v1/validate_oauth", headers=h)
+    assert r.status_code == 200
+    r = client.get("/aux/v1/validate_oauth?owner=uss1", headers=h)
+    assert r.status_code == 200
+    r = client.get("/aux/v1/validate_oauth?owner=other", headers=h)
+    assert r.status_code == 403
