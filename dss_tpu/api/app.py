@@ -130,23 +130,50 @@ def build_app(
     authorizer: Optional[Authorizer] = None,
     *,
     enable_scd: bool = True,
+    metrics=None,
+    dump_requests: bool = False,
+    stats_fn=None,
 ) -> web.Application:
-    app = web.Application(middlewares=[error_middleware])
+    from dss_tpu.obs.logging import make_access_log_middleware
+
+    middlewares = [
+        make_access_log_middleware(metrics, dump_requests=dump_requests),
+        error_middleware,
+    ]
+    app = web.Application(middlewares=middlewares)
 
     def auth(request, operation: str) -> str:
         """-> owner.  No authorizer configured (unit harness) -> anon."""
         if authorizer is None:
             return "anonymous"
-        return authorizer.authorize(
+        owner = authorizer.authorize(
             request.headers.get("Authorization"), operation
         )
+        request["dss_owner"] = owner
+        return owner
 
-    # -- health (no auth) ----------------------------------------------------
+    # -- health + metrics (no auth) ------------------------------------------
 
     async def healthy(request):
         return web.Response(text="ok")
 
     app.router.add_get("/healthy", healthy)
+
+    if metrics is not None:
+
+        async def metrics_handler(request):
+            if stats_fn is not None:
+                # stats take the store lock (writers hold it across
+                # device work) — keep the event loop free
+                stats = await _call(stats_fn)
+                for name, val in stats.items():
+                    metrics.set_gauge(name, val)
+            return web.Response(
+                text=metrics.render(),
+                content_type="text/plain",
+            )
+
+        app.router.add_get("/metrics", metrics_handler)
 
     # -- aux -----------------------------------------------------------------
 

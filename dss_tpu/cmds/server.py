@@ -64,16 +64,32 @@ def make_parser() -> argparse.ArgumentParser:
         action="store_true",
         help="disable auth entirely (local testing only)",
     )
+    p.add_argument(
+        "--dump_requests",
+        action="store_true",
+        help="log request bodies (reference --dump_requests)",
+    )
     return p
 
 
 def build(args) -> web.Application:
+    from dss_tpu.obs.logging import configure_logging, get_logger
+    from dss_tpu.obs.metrics import MetricsRegistry
+
+    configure_logging()
+    log = get_logger("dss.server")
     clock = Clock()
     store = DSSStore(
         storage=args.storage,
         clock=clock,
         wal_path=args.wal_path or None,
         wal_fsync=args.wal_fsync,
+    )
+    log.info(
+        "store ready: storage=%s wal=%s scd=%s",
+        args.storage,
+        args.wal_path or "(none)",
+        args.enable_scd,
     )
     rid = RIDService(store.rid, clock)
     scd = SCDService(store.scd, clock) if args.enable_scd else None
@@ -109,8 +125,16 @@ def build(args) -> web.Application:
             refresh_interval_s=args.key_refresh_timer or None,
         )
 
+    metrics = MetricsRegistry()
+
     return build_app(
-        rid, scd, authorizer, enable_scd=args.enable_scd
+        rid,
+        scd,
+        authorizer,
+        enable_scd=args.enable_scd,
+        metrics=metrics,
+        dump_requests=args.dump_requests,
+        stats_fn=store.stats,
     )
 
 

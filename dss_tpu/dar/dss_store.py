@@ -96,6 +96,12 @@ class RIDStoreImpl(RIDStore):
 
     # -- ISAs ----------------------------------------------------------------
 
+    def index_stats(self) -> dict:
+        return self._isa_index.stats()
+
+    def sub_index_stats(self) -> dict:
+        return self._sub_index.stats()
+
     def get_isa(self, id):
         with self._lock:
             isa = self._isas.get(id)
@@ -274,6 +280,12 @@ class RIDStoreImpl(RIDStore):
 
 
 class SCDStoreImpl(SCDStore):
+    def index_stats(self) -> dict:
+        return self._op_index.stats()
+
+    def sub_index_stats(self) -> dict:
+        return self._sub_index.stats()
+
     def __init__(self, *, clock, ts_oracle, owners, lock, journal, index_factory):
         self._clock = clock
         self._ts = ts_oracle
@@ -623,3 +635,16 @@ class DSSStore:
 
     def close(self):
         self.wal.close()
+
+    def stats(self) -> dict:
+        """Per-index gauges for /metrics (dss_dar_* names)."""
+        out = {}
+        for name, stats in (
+            ("isa", self.rid.index_stats),
+            ("rid_sub", self.rid.sub_index_stats),
+            ("op", self.scd.index_stats),
+            ("scd_sub", self.scd.sub_index_stats),
+        ):
+            for k, v in stats().items():
+                out[f"dss_dar_{name}_{k}"] = v
+        return out

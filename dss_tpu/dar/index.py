@@ -68,6 +68,9 @@ class MemorySpatialIndex:
         recs = {i: r for i, r in enumerate(self._recs.values())}
         return oracle.max_count_per_cell(recs, keys, owner_id, now)
 
+    def stats(self) -> dict:
+        return {"live_records": len(self._recs)}
+
 
 class TpuSpatialIndex:
     def __init__(self, **table_kwargs):
@@ -106,6 +109,9 @@ class TpuSpatialIndex:
         return self._table.max_owner_count(
             _to_keys(cells_u64), owner_id, now=int(now)
         )
+
+    def stats(self) -> dict:
+        return self._table.stats()
 
     @property
     def table(self) -> DarTable:

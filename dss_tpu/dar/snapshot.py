@@ -27,6 +27,7 @@ import numpy as np
 from dss_tpu.dar import oracle
 from dss_tpu.dar.oracle import Record
 from dss_tpu.dar.pack import pack_records, pow2_at_least
+from dss_tpu.ops.fastpath import FastTable
 from dss_tpu.ops.conflict import (
     INT32_MAX,
     NO_TIME_HI,
@@ -101,6 +102,10 @@ class DarTable:
         self._delta_ent = np.zeros(delta_capacity, np.int32)
         self._delta_count = 0
 
+        # batch fast path (built lazily from the last rebuild)
+        self._host_cols = None
+        self._fast = None
+
         # device state
         self._ents = self._empty_entity_table(entity_capacity)
         self._base = Postings(
@@ -148,6 +153,7 @@ class DarTable:
         """Insert or replace an entity. keys are int32 DAR keys."""
         keys = np.unique(np.asarray(keys, dtype=np.int32))
         with self._lock:
+            self._fast = None
             old_slot = self.slot_of.pop(entity_id, None)
             if old_slot is not None:
                 del self.records[old_slot]
@@ -215,6 +221,10 @@ class DarTable:
                 return False
             del self.records[slot]
             self._ents = _tombstone_row(self._ents, slot)
+            if self._fast is not None:
+                # no rebuild needed: flip the snapshot's live bit; the
+                # exact host re-filter drops the tombstoned slot
+                self._fast[1]["live"][slot] = False
             return True
 
     def _rebuild_locked(self, pending: Optional[Record] = None):
@@ -233,6 +243,8 @@ class DarTable:
         self.base_cap = packed.base_cap
         self._base_key = packed.post_key
         self._base_ent = packed.post_ent
+        self._host_cols = packed
+        self._fast = None
 
         self._ents = EntityTable(
             alt_lo=jnp.asarray(packed.alt_lo),
@@ -341,6 +353,101 @@ class DarTable:
                 if rec is not None:
                     out.append(rec.entity_id)
             return out
+
+    def _ensure_fast_locked(self):
+        """Build (or reuse) the batch fast path from the current base.
+        Folds any pending delta with a rebuild first.  Returns
+        (FastTable, snapshot dict) where the snapshot carries immutable
+        per-slot arrays + the slot->entity_id list, so queries can
+        assemble results without holding the lock (a concurrent upsert
+        mutates self.records in place)."""
+        if self._fast is None or self._delta_count:
+            self._rebuild_locked()
+            cols = self._host_cols
+            n = cols.n_postings
+            pe = self._base_ent[:n]
+            ids = [None] * (cols.capacity + 1)
+            for slot, rec in self.records.items():
+                ids[slot] = rec.entity_id
+            self._fast = (
+                FastTable(
+                    self._base_key[:n],
+                    pe,
+                    cols.alt_lo[pe],
+                    cols.alt_hi[pe],
+                    cols.t_start[pe],
+                    cols.t_end[pe],
+                    cols.active[pe],
+                ),
+                {
+                    "alt_lo": cols.alt_lo,
+                    "alt_hi": cols.alt_hi,
+                    "t0": cols.t_start,
+                    "t1": cols.t_end,
+                    # copied: remove() flips bits here without rebuilding
+                    "live": cols.active.copy(),
+                    "owner": cols.owner,
+                    "ids": ids,
+                },
+            )
+        return self._fast
+
+    def query_many(
+        self,
+        keys_list,  # sequence of int32 arrays (DAR keys per query)
+        alt_lo: np.ndarray,  # f32[B], -inf unbounded
+        alt_hi: np.ndarray,
+        t_start: np.ndarray,  # i64[B] ns, NO_TIME_LO unbounded
+        t_end: np.ndarray,
+        *,
+        now: int,
+        owner_ids: Optional[np.ndarray] = None,  # i32[B], -1 = no filter
+    ) -> List[List[str]]:
+        """Batched search via the fast path (host range lookup + dense
+        device filter + exact host re-check).  Exact same result sets
+        as query(); built for high-QPS read service and the bench."""
+        with self._lock:
+            ft, snap = self._ensure_fast_locked()
+        b = len(keys_list)
+        if b == 0:
+            return []
+        width = max(16, pow2_at_least(max(len(k) for k in keys_list), lo=16))
+        qkeys = np.full((b, width), -1, np.int32)
+        for i, k in enumerate(keys_list):
+            u = np.unique(np.asarray(k, np.int32))
+            qkeys[i, : len(u)] = u
+        qidx, offs = ft.query_batch(
+            qkeys, alt_lo, alt_hi, t_start, t_end, now=now
+        )
+        qidx, slots = ft.exact_filter(
+            qidx,
+            offs,
+            records_alt_lo=snap["alt_lo"],
+            records_alt_hi=snap["alt_hi"],
+            records_t0=snap["t0"],
+            records_t1=snap["t1"],
+            records_live=snap["live"],
+            alt_lo=alt_lo,
+            alt_hi=alt_hi,
+            t_start=t_start,
+            t_end=t_end,
+            now=now,
+        )
+        if owner_ids is not None:
+            keep = (owner_ids[qidx] < 0) | (
+                snap["owner"][slots] == owner_ids[qidx]
+            )
+            qidx, slots = qidx[keep], slots[keep]
+        # dedup (an entity can hit via several cells) and assemble ids
+        pairs = np.unique(qidx * np.int64(2**32) + slots)
+        ids = snap["ids"]
+        out = [[] for _ in range(b)]
+        for p in pairs:
+            i, s = int(p >> 32), int(p & 0xFFFFFFFF)
+            eid = ids[s] if s < len(ids) else None
+            if eid is not None:
+                out[i].append(eid)
+        return out
 
     def max_owner_count(self, keys: np.ndarray, owner_id: int, *, now: int) -> int:
         """DSS0030 quota metric: max per-cell count of live entities owned

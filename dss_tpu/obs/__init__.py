@@ -1,0 +1,14 @@
+"""Observability: structured logging + RED metrics.
+
+The reference uses zap JSON logs with gRPC interceptors
+(pkg/logging) and deploys Prometheus/Grafana but exposes no app-level
+metrics (build/deploy/grpc-backend.libsonnet:6-9 — an inventory gap
+SURVEY.md §5 calls out).  Here both are first-class: JSON logs with a
+request middleware and proto-dump analog, and per-route RED metrics
+served in Prometheus text format at /metrics.
+"""
+
+from dss_tpu.obs.logging import configure_logging, get_logger
+from dss_tpu.obs.metrics import MetricsRegistry
+
+__all__ = ["configure_logging", "get_logger", "MetricsRegistry"]

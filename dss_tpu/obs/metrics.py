@@ -1,0 +1,114 @@
+"""RED metrics with Prometheus text exposition.
+
+Closes the reference's app-metrics gap (its deploy scrapes only CRDB /
+Istio; the Go services expose nothing — SURVEY.md §5).  Exposes:
+
+  dss_requests_total{method,route,status}        counter
+  dss_request_duration_seconds{method,route}     histogram
+  dss_dar_entities / dss_dar_postings / ...      gauges via set_gauge
+
+Route labels are templatized (UUID path segments -> ":id") to bound
+cardinality.  Scrape at GET /metrics.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from typing import Dict, Tuple
+
+_UUID = re.compile(
+    r"[0-9a-fA-F]{8}-[0-9a-fA-F]{4}-[0-9a-fA-F]{4}"
+    r"-[0-9a-fA-F]{4}-[0-9a-fA-F]{12}"
+)
+_VERSIONISH = re.compile(r"^[0-9a-z]{10,}$")
+
+BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+    5.0, 10.0,
+)
+
+
+def route_template(path: str) -> str:
+    parts = path.split("/")
+    out = []
+    for p in parts:
+        if _UUID.fullmatch(p):
+            out.append(":id")
+        elif _VERSIONISH.fullmatch(p) and len(out) >= 2 and out[-1] == ":id":
+            out.append(":version")
+        else:
+            out.append(p)
+    return "/".join(out)
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[Tuple[str, str, int], int] = {}
+        self._hist: Dict[Tuple[str, str], list] = {}
+        self._hist_sum: Dict[Tuple[str, str], float] = {}
+        self._hist_cnt: Dict[Tuple[str, str], int] = {}
+        self._gauges: Dict[str, float] = {}
+
+    def observe_request(
+        self, method: str, path: str, status: int, duration_s: float
+    ) -> None:
+        route = route_template(path)
+        with self._lock:
+            k = (method, route, status)
+            self._counters[k] = self._counters.get(k, 0) + 1
+            hk = (method, route)
+            if hk not in self._hist:
+                self._hist[hk] = [0] * len(BUCKETS)
+                self._hist_sum[hk] = 0.0
+                self._hist_cnt[hk] = 0
+            for i, b in enumerate(BUCKETS):
+                if duration_s <= b:
+                    self._hist[hk][i] += 1
+            self._hist_sum[hk] += duration_s
+            self._hist_cnt[hk] += 1
+
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = float(value)
+
+    def render(self) -> str:
+        """Prometheus text exposition format."""
+        lines = []
+        with self._lock:
+            lines.append("# TYPE dss_requests_total counter")
+            for (m, r, s), v in sorted(self._counters.items()):
+                lines.append(
+                    f'dss_requests_total{{method="{m}",route="{r}",'
+                    f'status="{s}"}} {v}'
+                )
+            lines.append(
+                "# TYPE dss_request_duration_seconds histogram"
+            )
+            for hk in sorted(self._hist):
+                m, r = hk
+                lab = f'method="{m}",route="{r}"'
+                cum = 0
+                for i, b in enumerate(BUCKETS):
+                    cum = self._hist[hk][i]
+                    lines.append(
+                        f"dss_request_duration_seconds_bucket{{{lab},"
+                        f'le="{b}"}} {cum}'
+                    )
+                lines.append(
+                    f"dss_request_duration_seconds_bucket{{{lab},"
+                    f'le="+Inf"}} {self._hist_cnt[hk]}'
+                )
+                lines.append(
+                    f"dss_request_duration_seconds_sum{{{lab}}} "
+                    f"{self._hist_sum[hk]:.6f}"
+                )
+                lines.append(
+                    f"dss_request_duration_seconds_count{{{lab}}} "
+                    f"{self._hist_cnt[hk]}"
+                )
+            for name, v in sorted(self._gauges.items()):
+                lines.append(f"# TYPE {name} gauge")
+                lines.append(f"{name} {v}")
+        return "\n".join(lines) + "\n"

@@ -1,0 +1,91 @@
+"""Pallas TPU kernel for the fast-path window filter.
+
+Flat window list: each window is ONE 128-posting block of the
+attribute-inlined postings array plus per-window query scalars.  Each
+grid program handles GROUP=32 consecutive windows (int8 tiling needs
+32x128 output blocks), DMA-ing each window's block HBM->VMEM double-
+buffered and running the 4D compare on the VPU.  Equivalent to
+FastTable._filter_xla but with explicit DMA scheduling.
+
+Note: the tunneled remote-compile service in this dev environment
+cannot compile ANY Pallas kernel (Mosaic "failed to legalize
+func.func" even on trivial kernels), so CI exercises this in interpret
+mode (CPU); on directly-attached TPU hardware pass interpret=False.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+BLOCK = 128
+GROUP = 32  # windows per grid program (int8 min tile sublanes)
+
+
+def _kernel(blk_ref, qkey_ref, qalo_ref, qahi_ref, qt0_ref, qt1_ref,
+            packed_hbm, mask_ref, scratch, sems):
+    g = pl.program_id(0)
+    base = g * GROUP
+
+    def dma(i, slot):
+        slot = jnp.int32(slot)
+        return pltpu.make_async_copy(
+            packed_hbm.at[pl.ds(blk_ref[base + i], 1)],
+            scratch.at[slot],
+            sems.at[slot],
+        )
+
+    dma(jnp.int32(0), 0).start()
+    for i in range(GROUP):
+        slot = i % 2
+        if i + 1 < GROUP:
+            dma(jnp.int32(i + 1), (i + 1) % 2).start()
+        dma(jnp.int32(i), slot).wait()
+        win = scratch[slot]  # (1, 5, 128) i32
+        w = base + i
+        hit = (
+            (win[:, 0, :] == qkey_ref[w])
+            & (win[:, 2, :] >= qalo_ref[w])
+            & (win[:, 1, :] <= qahi_ref[w])
+            & (win[:, 4, :] >= qt0_ref[w])
+            & (win[:, 3, :] <= qt1_ref[w])
+        )
+        mask_ref[i : i + 1, :] = hit.astype(jnp.int8)
+
+
+@partial(jax.jit, static_argnames=("interpret",))
+def filter_windows_pallas(
+    p3,  # (NB, 5, 128) i32
+    win_blk,  # (NW,) i32 block index per window, NW % GROUP == 0
+    qk,  # (NW,) i32 key to match (negative = never matches)
+    qalo_mm,  # (NW,) i32
+    qahi_mm,
+    qt0s,
+    qt1s,
+    *,
+    interpret: bool = False,
+):
+    """-> per-lane hit mask (NW, 128) int8."""
+    nw = win_blk.shape[0]
+    assert nw % GROUP == 0, f"NW must be padded to a multiple of {GROUP}"
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=6,
+        grid=(nw // GROUP,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=[pl.BlockSpec((GROUP, BLOCK), lambda g, *_: (g, 0))],
+        scratch_shapes=[
+            pltpu.VMEM((2, 1, 5, BLOCK), jnp.int32),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    return pl.pallas_call(
+        _kernel,
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct((nw, BLOCK), jnp.int8)],
+        interpret=interpret,
+    )(win_blk, qk, qalo_mm, qahi_mm, qt0s, qt1s, p3)[0]

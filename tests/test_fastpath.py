@@ -1,0 +1,198 @@
+"""Fast-path conflict kernels vs the exact oracle (CPU).
+
+Covers both device implementations: the XLA block-gather filter and
+the Pallas DMA kernel (interpret mode — the real-TPU compile is
+environment-gated, see ops/fastpath_pallas.py docstring).
+"""
+
+import numpy as np
+import pytest
+
+from dss_tpu.dar import oracle
+from dss_tpu.dar.oracle import Record
+from dss_tpu.ops.conflict import NO_TIME_HI, NO_TIME_LO
+from dss_tpu.ops.fastpath import FastTable
+
+NOW = 1_700_000_000_000_000_000
+HOUR = 3_600_000_000_000
+
+
+def _mk_table(rng, n, key_space=400):
+    recs = []
+    for i in range(n):
+        nk = int(rng.integers(1, 10))
+        keys = np.unique(rng.integers(0, key_space, nk).astype(np.int32))
+        alo, ahi = sorted(rng.uniform(0, 3000, 2))
+        t0 = NOW + int(rng.integers(-5, 5)) * HOUR
+        t1 = t0 + int(rng.integers(1, 8)) * HOUR
+        recs.append(
+            Record(
+                entity_id=f"e{i}",
+                keys=keys,
+                alt_lo=float(alo),
+                alt_hi=float(ahi),
+                t_start=t0,
+                t_end=t1,
+                owner_id=int(rng.integers(0, 5)),
+            )
+        )
+    # pack into postings
+    pk, pe = [], []
+    for slot, r in enumerate(recs):
+        pk.extend(int(k) for k in r.keys)
+        pe.extend([slot] * len(r.keys))
+    pk = np.asarray(pk, np.int32)
+    pe = np.asarray(pe, np.int32)
+    order = np.argsort(pk, kind="stable")
+    pk, pe = pk[order], pe[order]
+    ft = FastTable(
+        pk,
+        pe,
+        np.asarray([recs[s].alt_lo for s in pe], np.float32),
+        np.asarray([recs[s].alt_hi for s in pe], np.float32),
+        np.asarray([recs[s].t_start for s in pe], np.int64),
+        np.asarray([recs[s].t_end for s in pe], np.int64),
+        np.ones(len(pe), bool),
+    )
+    return recs, ft
+
+
+def _exact_arrays(recs):
+    return dict(
+        records_alt_lo=np.asarray([r.alt_lo for r in recs], np.float32),
+        records_alt_hi=np.asarray([r.alt_hi for r in recs], np.float32),
+        records_t0=np.asarray([r.t_start for r in recs], np.int64),
+        records_t1=np.asarray([r.t_end for r in recs], np.int64),
+        records_live=np.ones(len(recs), bool),
+    )
+
+
+@pytest.mark.parametrize("use_pallas", [False, True])
+def test_fastpath_matches_oracle(use_pallas):
+    rng = np.random.default_rng(42)
+    recs, ft = _mk_table(rng, 250)
+    B, W = 8, 16
+    qkeys = np.full((B, W), -1, np.int32)
+    alo = np.full(B, -np.inf, np.float32)
+    ahi = np.full(B, np.inf, np.float32)
+    ts = np.full(B, NO_TIME_LO, np.int64)
+    te = np.full(B, NO_TIME_HI, np.int64)
+    for i in range(B):
+        nk = int(rng.integers(1, W))
+        u = np.unique(rng.integers(0, 400, nk).astype(np.int32))
+        qkeys[i, : len(u)] = u
+        if i % 2:
+            a, b = sorted(rng.uniform(0, 3000, 2))
+            alo[i], ahi[i] = a, b
+        if i % 3:
+            ts[i] = NOW - 2 * HOUR
+            te[i] = NOW + 2 * HOUR
+
+    qidx, offs = ft.query_batch(
+        qkeys, alo, ahi, ts, te, now=NOW,
+        use_pallas=use_pallas, interpret=use_pallas,
+    )
+    qidx, slots = ft.exact_filter(
+        qidx, offs, **_exact_arrays(recs),
+        alt_lo=alo, alt_hi=ahi, t_start=ts, t_end=te, now=NOW,
+    )
+    recs_map = dict(enumerate(recs))
+    for i in range(B):
+        want = sorted(
+            oracle.search(
+                recs_map,
+                qkeys[i][qkeys[i] >= 0],
+                None if alo[i] == -np.inf else float(alo[i]),
+                None if ahi[i] == np.inf else float(ahi[i]),
+                None if ts[i] == NO_TIME_LO else int(ts[i]),
+                None if te[i] == NO_TIME_HI else int(te[i]),
+                NOW,
+            )
+        )
+        got = sorted(set(slots[qidx == i].tolist()))
+        assert got == want, f"query {i} (pallas={use_pallas})"
+
+
+@pytest.mark.parametrize("use_pallas", [False, True])
+def test_fastpath_hot_cell_long_run(use_pallas):
+    """A cell with a postings run spanning many 128-blocks must return
+    every entity (regression: the old fixed 2-block window dropped the
+    tail of runs longer than ~256)."""
+    n = 500  # run of 500 postings on one cell -> 5 blocks
+    pk = np.full(n + 10, 7, np.int32)
+    pk[n:] = 9  # a few postings on another cell after the run
+    pe = np.arange(n + 10, dtype=np.int32)
+    pe[n:] = np.arange(10)
+    ft = FastTable(
+        pk, pe,
+        np.zeros(n + 10, np.float32),
+        np.full(n + 10, 100.0, np.float32),
+        np.full(n + 10, NOW - HOUR, np.int64),
+        np.full(n + 10, NOW + HOUR, np.int64),
+        np.ones(n + 10, bool),
+    )
+    qkeys = np.full((1, 16), -1, np.int32)
+    qkeys[0, 0] = 7
+    qidx, offs = ft.query_batch(
+        qkeys,
+        np.full(1, -np.inf, np.float32),
+        np.full(1, np.inf, np.float32),
+        np.full(1, NO_TIME_LO, np.int64),
+        np.full(1, NO_TIME_HI, np.int64),
+        now=NOW,
+        use_pallas=use_pallas,
+        interpret=use_pallas,
+    )
+    slots = np.unique(ft.host_ent[offs])
+    assert len(slots) == n, f"lost {n - len(slots)} of {n} entities"
+
+
+def test_fastpath_tombstones_and_subsecond_edges():
+    rng = np.random.default_rng(1)
+    recs, _ = _mk_table(rng, 20)
+    # one entity ends 1ns before the query window: quantization rounds
+    # its end UP to the next second (conservative), exact filter must
+    # then drop it
+    t_q = NOW + HOUR
+    recs[0] = Record(
+        entity_id="edge",
+        keys=np.asarray([7], np.int32),
+        alt_lo=0.0,
+        alt_hi=100.0,
+        t_start=NOW - HOUR,
+        t_end=t_q - 1,  # ends 1ns before the window
+        owner_id=0,
+    )
+    pk, pe = [], []
+    for slot, r in enumerate(recs):
+        pk.extend(int(k) for k in r.keys)
+        pe.extend([slot] * len(r.keys))
+    pk, pe = np.asarray(pk, np.int32), np.asarray(pe, np.int32)
+    order = np.argsort(pk, kind="stable")
+    pk, pe = pk[order], pe[order]
+    live = pe != 3  # tombstone slot 3
+    ft = FastTable(
+        pk, pe,
+        np.asarray([recs[s].alt_lo for s in pe], np.float32),
+        np.asarray([recs[s].alt_hi for s in pe], np.float32),
+        np.asarray([recs[s].t_start for s in pe], np.int64),
+        np.asarray([recs[s].t_end for s in pe], np.int64),
+        live,
+    )
+    qkeys = np.full((1, 16), -1, np.int32)
+    qkeys[0, 0] = 7
+    alo = np.full(1, -np.inf, np.float32)
+    ahi = np.full(1, np.inf, np.float32)
+    ts = np.asarray([t_q], np.int64)
+    te = np.asarray([t_q + HOUR], np.int64)
+    qidx, offs = ft.query_batch(qkeys, alo, ahi, ts, te, now=NOW)
+    ex = _exact_arrays(recs)
+    ex["records_live"][3] = False
+    qidx2, slots = ft.exact_filter(
+        qidx, offs, **ex, alt_lo=alo, alt_hi=ahi, t_start=ts, t_end=te,
+        now=NOW,
+    )
+    # the 1ns-early entity passed the coarse filter but not the exact one
+    assert 0 not in slots.tolist()
+    # tombstoned slot 3 never appears
+    assert 3 not in slots.tolist()

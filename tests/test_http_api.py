@@ -63,20 +63,6 @@ RID_SCOPE_STR = (
 SCD_SCOPE_STR = "utm.strategic_coordination"
 
 
-@pytest.fixture(scope="module")
-def keypair():
-    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
-    priv = key.private_bytes(
-        serialization.Encoding.PEM,
-        serialization.PrivateFormat.PKCS8,
-        serialization.NoEncryption(),
-    )
-    pub = key.public_key().public_bytes(
-        serialization.Encoding.PEM,
-        serialization.PublicFormat.SubjectPublicKeyInfo,
-    )
-    return priv, pub
-
 
 @pytest.fixture(scope="module")
 def server(keypair):

@@ -1,0 +1,82 @@
+"""DarTable.query_many (fast path) must agree with query() exactly."""
+
+import numpy as np
+
+from dss_tpu.dar.snapshot import DarTable
+from dss_tpu.ops.conflict import NO_TIME_HI, NO_TIME_LO
+
+NOW = 1_700_000_000_000_000_000
+HOUR = 3_600_000_000_000
+
+
+def test_query_many_matches_query():
+    rng = np.random.default_rng(9)
+    t = DarTable()
+    for i in range(200):
+        nk = int(rng.integers(1, 8))
+        keys = np.unique(rng.integers(0, 300, nk).astype(np.int32))
+        alo, ahi = sorted(rng.uniform(0, 3000, 2))
+        t0 = NOW + int(rng.integers(-5, 5)) * HOUR
+        t.upsert(
+            f"e{i}", keys, float(alo), float(ahi),
+            t0, t0 + int(rng.integers(1, 8)) * HOUR,
+            int(rng.integers(0, 4)),
+        )
+    # a few removals and re-upserts so tombstones exist
+    t.remove("e3")
+    t.remove("e77")
+    t.upsert("e5", np.asarray([1, 2], np.int32), 0.0, 10.0, NOW, NOW + HOUR, 1)
+
+    B = 12
+    keys_list, alo, ahi, ts, te = [], [], [], [], []
+    for i in range(B):
+        nk = int(rng.integers(1, 20))
+        keys_list.append(np.unique(rng.integers(0, 300, nk).astype(np.int32)))
+        if i % 2:
+            a, b = sorted(rng.uniform(0, 3000, 2))
+        else:
+            a, b = -np.inf, np.inf
+        alo.append(a)
+        ahi.append(b)
+        if i % 3:
+            ts.append(NOW - 2 * HOUR)
+            te.append(NOW + 2 * HOUR)
+        else:
+            ts.append(NO_TIME_LO)
+            te.append(NO_TIME_HI)
+    got = t.query_many(
+        keys_list,
+        np.asarray(alo, np.float32),
+        np.asarray(ahi, np.float32),
+        np.asarray(ts, np.int64),
+        np.asarray(te, np.int64),
+        now=NOW,
+    )
+    for i in range(B):
+        wa = None if alo[i] == -np.inf else float(alo[i])
+        wb = None if ahi[i] == np.inf else float(ahi[i])
+        wt0 = None if ts[i] == NO_TIME_LO else int(ts[i])
+        wt1 = None if te[i] == NO_TIME_HI else int(te[i])
+        # query() expects raw dar keys
+        want = sorted(
+            t.query(keys_list[i], wa, wb, wt0, wt1, now=NOW)
+        )
+        assert sorted(got[i]) == want, f"query {i}"
+
+
+def test_query_many_sees_writes_after_fast_build():
+    t = DarTable()
+    t.upsert("a", np.asarray([5], np.int32), 0.0, 100.0, NOW, NOW + HOUR, 0)
+    args = (
+        [np.asarray([5], np.int32)],
+        np.asarray([-np.inf], np.float32),
+        np.asarray([np.inf], np.float32),
+        np.asarray([NO_TIME_LO], np.int64),
+        np.asarray([NO_TIME_HI], np.int64),
+    )
+    assert t.query_many(*args, now=NOW) == [["a"]]
+    # a write after the fast table was built must invalidate it
+    t.upsert("b", np.asarray([5], np.int32), 0.0, 100.0, NOW, NOW + HOUR, 0)
+    assert sorted(t.query_many(*args, now=NOW)[0]) == ["a", "b"]
+    t.remove("a")
+    assert t.query_many(*args, now=NOW) == [["b"]]
