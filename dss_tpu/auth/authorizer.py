@@ -217,6 +217,14 @@ class Authorizer:
                 "token expiration time is too far in the future, "
                 "max token duration is 1 hour"
             )
+        nbf = payload.get("nbf")
+        if nbf is not None:
+            try:
+                nbf = float(nbf)
+            except (TypeError, ValueError):
+                raise errors.unauthenticated("bad token nbf")
+            if nbf > now:
+                raise errors.unauthenticated("token not yet valid")
         if not payload.get("iss"):
             raise errors.unauthenticated("missing Issuer URI")
         aud = payload.get("aud", "")

@@ -87,7 +87,16 @@ def make_access_log_middleware(metrics=None, dump_requests: bool = False):
         start = time.perf_counter()
         body = None
         if dump_requests and request.can_read_body:
-            body = await request.text()
+            # bound the dump buffer: skip bodies over 64 KB (or with no
+            # declared length) so a large body can't inflate per-request
+            # memory; truncated again to 4096 chars at log time below
+            cl = request.content_length
+            if cl is not None and cl <= 65536:
+                body = await request.text()
+            elif cl is None:
+                body = "(body of undeclared length not dumped)"
+            else:
+                body = f"(body of {cl} bytes not dumped)"
         status = 500
         try:
             resp = await handler(request)

@@ -93,6 +93,10 @@ def test_claims_rules(keypair):
     assert _auth_code(a, jwtlib.sign_rs256(claims(exp=NOW - 10), priv)) == errors.Code.UNAUTHENTICATED
     # expiry too far out (> 1h, claims.go:49-52)
     assert _auth_code(a, jwtlib.sign_rs256(claims(exp=NOW + 7200), priv)) == errors.Code.UNAUTHENTICATED
+    # not yet valid (nbf in the future; jwt-go StandardClaims.Valid analog)
+    assert _auth_code(a, jwtlib.sign_rs256(claims(nbf=NOW + 60), priv)) == errors.Code.UNAUTHENTICATED
+    # nbf in the past is fine
+    assert a.authorize(f"Bearer {jwtlib.sign_rs256(claims(nbf=NOW - 60), priv)}", "/x/Y") == "uss1"
     # missing issuer
     assert _auth_code(a, jwtlib.sign_rs256(claims(iss=""), priv)) == errors.Code.UNAUTHENTICATED
     # wrong audience
