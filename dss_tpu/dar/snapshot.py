@@ -222,9 +222,10 @@ class DarTable:
             del self.records[slot]
             self._ents = _tombstone_row(self._ents, slot)
             if self._fast is not None:
-                # no rebuild needed: flip the snapshot's live bit; the
-                # exact host re-filter drops the tombstoned slot
-                self._fast[1]["live"][slot] = False
+                # no rebuild needed: flip the FastTable's host live bit;
+                # collect() drops the slot during result assembly (the
+                # device columns are untouched until the next rebuild)
+                self._fast[0].mark_dead(slot)
             return True
 
     def _rebuild_locked(self, pending: Optional[Record] = None):
@@ -369,27 +370,26 @@ class DarTable:
             ids = [None] * (cols.capacity + 1)
             for slot, rec in self.records.items():
                 ids[slot] = rec.entity_id
-            self._fast = (
-                FastTable(
-                    self._base_key[:n],
-                    pe,
-                    cols.alt_lo[pe],
-                    cols.alt_hi[pe],
-                    cols.t_start[pe],
-                    cols.t_end[pe],
-                    cols.active[pe],
-                ),
-                {
+            ft = FastTable(
+                self._base_key[:n],
+                pe,
+                cols.alt_lo[pe],
+                cols.alt_hi[pe],
+                cols.t_start[pe],
+                cols.t_end[pe],
+                cols.active[pe],
+                slot_exact={
                     "alt_lo": cols.alt_lo,
                     "alt_hi": cols.alt_hi,
                     "t0": cols.t_start,
                     "t1": cols.t_end,
-                    # copied: remove() flips bits here without rebuilding
                     "live": cols.active.copy(),
-                    "owner": cols.owner,
-                    "ids": ids,
                 },
             )
+            # owner + ids are the only per-slot columns the read path
+            # still needs host-side; exact filtering happens on device
+            # (FastTable.slot_exact carries the fallback copies)
+            self._fast = (ft, {"owner": cols.owner, "ids": ids})
         return self._fast
 
     def query_many(
@@ -416,22 +416,8 @@ class DarTable:
         for i, k in enumerate(keys_list):
             u = np.unique(np.asarray(k, np.int32))
             qkeys[i, : len(u)] = u
-        qidx, offs = ft.query_batch(
+        qidx, slots = ft.query_fused(
             qkeys, alt_lo, alt_hi, t_start, t_end, now=now
-        )
-        qidx, slots = ft.exact_filter(
-            qidx,
-            offs,
-            records_alt_lo=snap["alt_lo"],
-            records_alt_hi=snap["alt_hi"],
-            records_t0=snap["t0"],
-            records_t1=snap["t1"],
-            records_live=snap["live"],
-            alt_lo=alt_lo,
-            alt_hi=alt_hi,
-            t_start=t_start,
-            t_end=t_end,
-            now=now,
         )
         if owner_ids is not None:
             keep = (owner_ids[qidx] < 0) | (

@@ -4,36 +4,38 @@ Division of labor (each side doing what its hardware is good at):
 
   host (CPU)   — cell-key -> postings-range lookup (numpy searchsorted
                  over the sorted key column; the CRDB range-lookup
-                 analog), plus exact re-filtering and result assembly
-                 from the hit bitmask.
+                 analog), plus result assembly from the compacted hit
+                 words the device returns.
   device (TPU) — the dense part: for every (query, cell) window of the
-                 attribute-inlined postings blocks, a vectorized 4D
-                 overlap test, bit-packed to 16 bits/word with an MXU
-                 matmul (f32-exact below 2^24) so the returned mask is
-                 256 KB instead of 8 MB.
+                 attribute-inlined postings blocks, a vectorized EXACT
+                 4D overlap test (f32 altitudes, i64 ns times), hits
+                 bit-packed to u32 words, and the non-empty words
+                 compacted on device (hand-rolled cumsum+scatter — NOT
+                 jnp.nonzero, whose searchsorted lowering is ~20x
+                 slower on TPU) so the D2H transfer is proportional to
+                 hits, not windows scanned.
 
-Layout: postings are packed into 128-wide blocks, (NB, 5, 128) int32:
-row 0 cell key, 1 alt_lo floor(mm), 2 alt_hi ceil(mm), 3 t_start
-floor(s), 4 t_end ceil(s) (tombstoned postings get INT32_MIN so they
-never pass the `t_end >= now` test).  Quantization is conservative
-(intervals widened outward), so the device mask may contain false
-positives and never false negatives; the host re-checks candidates
-against the exact float/int64-ns record values — same two-phase
-conservative-then-exact shape as the reference's cell covering
-(concepts.md:26) and the SQL it feeds
-(pkg/scd/store/cockroach/operations.go:374-435).
+This replaces the reference's per-query SQL conflict scan
+(pkg/scd/store/cockroach/operations.go:374-435) and the RID
+`cells && $x` search (pkg/rid/cockroach/identification_service_area.go
+:166-197).
 
-No sorts, no scalar gathers, no int64 on device: the three TPU
-slow paths the naive kernel (dss_tpu.ops.conflict) hits.
+Submit/collect are asynchronous: submit() enqueues the upload + kernel
+and starts the D2H copy without blocking, so many batches pipeline and
+the (tunneled) dispatch round trip is paid once per *stream*, not once
+per batch.
 
 Two device implementations:
   - XLA (default): leading-dim block gather (embedding-lookup shape).
-  - Pallas (`use_pallas=True`): explicit double-buffered HBM->VMEM DMA
-    per window.  Compiles with the standard Mosaic toolchain; the
-    tunneled remote-compile service in this dev environment cannot
-    compile any Pallas kernel ("failed to legalize func.func" even for
-    trivial kernels), so tests exercise it in interpret mode and the
-    XLA path stays the default here.
+  - Pallas (`use_pallas=True`, legacy mask path): explicit
+    double-buffered HBM->VMEM DMA per window.  Compiles with the
+    standard Mosaic toolchain; the tunneled remote-compile service in
+    this dev environment cannot compile any Pallas kernel ("failed to
+    legalize func.func" even for trivial kernels), so tests exercise
+    it in interpret mode and the XLA path stays the default here.
+
+The legacy quantized-mask path (query_batch + exact_filter host
+re-check) is kept as the overflow fallback and the Pallas host.
 """
 
 from __future__ import annotations
@@ -90,6 +92,25 @@ def _bitpack_weights() -> np.ndarray:
     return w
 
 
+class PendingBatch:
+    """In-flight fused query batch: device future + host decode state.
+
+    Created by FastTable.submit(); resolved by FastTable.collect().
+    Nothing here blocks — jax dispatch is async and submit() starts the
+    D2H copy (copy_to_host_async), so many batches can be in flight at
+    once and the host sync per collect only waits for the stream."""
+
+    __slots__ = ("out", "win_q", "win_blk", "host_inputs", "nw", "max_words")
+
+    def __init__(self, out, win_q, win_blk, host_inputs, nw, max_words):
+        self.out = out  # device flat i32: [n_words, wordpos..., bits...]
+        self.win_q = win_q
+        self.win_blk = win_blk
+        self.host_inputs = host_inputs  # for the overflow fallback
+        self.nw = nw
+        self.max_words = max_words
+
+
 class FastTable:
     """Device-resident packed postings + host decode state."""
 
@@ -103,9 +124,19 @@ class FastTable:
         t_end: np.ndarray,
         live: np.ndarray,  # bool[P]
         *,
+        slot_exact: Optional[dict] = None,
         device=None,
     ):
         P = len(post_key)
+        # query_batch pads with key -1 (per-row qkeys pad) and -2 (the
+        # never-matching window pad); both must stay distinguishable
+        # from real DAR keys, so keys are required to be non-negative
+        # (cell_to_dar_key yields 30-bit keys, geo/s2cell.py).
+        if P and int(post_key.min()) < 0:
+            raise ValueError(
+                f"FastTable requires non-negative DAR keys, got min "
+                f"{int(post_key.min())}"
+            )
         self.n_postings = P
         # 2 extra blocks of padding so lo_blk+1 never reads out of range
         ppad = ((P + 2 * BLOCK - 1) // (2 * BLOCK)) * 2 * BLOCK + 4 * BLOCK
@@ -122,6 +153,33 @@ class FastTable:
         self.host_key = np.asarray(post_key)
         self.host_ent = np.asarray(post_ent)
         self.bitpack_w = jax.device_put(_bitpack_weights(), device)
+        self._device = device
+
+        # Fused on-device path: EXACT per-posting attribute columns in
+        # block layout, resident in HBM, so the window test is exact
+        # (no quantization, no host re-filter).  Tombstoned postings
+        # get t_end = NO_TIME_LO so `t_end >= now` never passes;
+        # post-build tombstones are dropped host-side in collect() via
+        # slot_exact["live"].  slot_exact: {"alt_lo","alt_hi","t0",
+        # "t1","live"} per-slot arrays (host, for fallback + liveness).
+        self.slot_exact = None
+        if slot_exact is not None:
+            nblo = np.int64(-(2**62))
+            b_alo = np.full(ppad, np.inf, np.float32)
+            b_ahi = np.full(ppad, -np.inf, np.float32)
+            b_t0 = np.full(ppad, 2**62, np.int64)
+            b_t1 = np.full(ppad, nblo, np.int64)
+            b_alo[:P] = np.asarray(alt_lo, np.float32)
+            b_ahi[:P] = np.asarray(alt_hi, np.float32)
+            b_t0[:P] = np.asarray(t_start, np.int64)
+            b_t1[:P] = np.where(np.asarray(live, bool), np.asarray(t_end, np.int64), nblo)
+            self.b_alo = jax.device_put(b_alo.reshape(nb, BLOCK), device)
+            self.b_ahi = jax.device_put(b_ahi.reshape(nb, BLOCK), device)
+            self.b_t0 = jax.device_put(b_t0.reshape(nb, BLOCK), device)
+            self.b_t1 = jax.device_put(b_t1.reshape(nb, BLOCK), device)
+            self.slot_exact = {
+                k: np.asarray(v) for k, v in slot_exact.items()
+            }
 
     # -- device kernels ------------------------------------------------------
 
@@ -184,6 +242,263 @@ class FastTable:
             interpret=interpret,
         )
 
+    def mark_dead(self, slot: int) -> None:
+        """Tombstone one slot in place (no rebuild): flips the host
+        live bit; collect() drops the slot during result assembly, so
+        the fused path stops returning it immediately."""
+        if self.slot_exact is None:
+            return
+        self.slot_exact["live"][slot] = False
+
+    # -- fused on-device kernel ----------------------------------------------
+
+    WORDS = BLOCK // 32  # u32 hit words per window
+
+    @staticmethod
+    @partial(jax.jit, static_argnames=("max_words", "chunk"))
+    def _fused_xla(
+        b_alo, b_ahi, b_t0, b_t1,  # (NB, 128) exact block columns
+        wins,  # (2, NWpad) i32: [block index, start | end<<8 | qidx<<16]
+        q_alo, q_ahi,  # exact per-query f32[B]
+        q_t0, q_t1,  # exact per-query i64[B]
+        now,  # i64 scalar
+        *, max_words, chunk=16384,
+    ):
+        """Exact window filter + hit bit-packing + word compaction, all
+        on device.  Each window is one postings run's slice of one
+        128-block, described by [start, end) lanes — no per-lane key
+        compare (and no key gather) needed.  Returns one flat i32
+        array:
+
+          out[0]                     = count of non-empty hit words
+          out[1 : 1+max_words]       = flat word positions (window*4+w)
+          out[1+max_words : ]        = u32 hit bits per word (as i32)
+
+        The D2H transfer is proportional to hit words, not windows
+        scanned.  Compaction is a hand-rolled cumsum+scatter (~35x
+        faster than jnp.nonzero's searchsorted lowering on TPU)."""
+        nw = wins.shape[1]
+        win_blk, meta = wins[0], wins[1]
+        win_q = meta >> 16
+        lanes = jnp.arange(BLOCK, dtype=jnp.int32)
+
+        def one_chunk(c):
+            blk, meta_c, alo_c, ahi_c, t0_c, t1_c = c
+            start = meta_c & 0xFF
+            end = (meta_c >> 8) & 0xFF
+            hit = (
+                (lanes[None, :] >= start[:, None])
+                & (lanes[None, :] < end[:, None])
+                & (jnp.take(b_ahi, blk, axis=0) >= alo_c[:, None])
+                & (jnp.take(b_alo, blk, axis=0) <= ahi_c[:, None])
+                & (jnp.take(b_t1, blk, axis=0) >= jnp.maximum(t0_c, now)[:, None])
+                & (jnp.take(b_t0, blk, axis=0) <= t1_c[:, None])
+            )  # (C, 128) bool, exact
+            # bit-pack 128 lanes -> 4 u32 words (exact, incl. bit 31:
+            # disjoint bits, so modular i32 addition == bitwise OR)
+            h = hit.astype(jnp.int32).reshape(-1, FastTable.WORDS, 32)
+            return jnp.sum(
+                h << jnp.arange(32, dtype=jnp.int32)[None, None, :],
+                axis=2,
+                dtype=jnp.int32,
+            )  # (C, 4) i32 bit patterns
+
+        cargs = (
+            win_blk,
+            meta,
+            jnp.take(q_alo, win_q),
+            jnp.take(q_ahi, win_q),
+            jnp.take(q_t0, win_q),
+            jnp.take(q_t1, win_q),
+        )
+        if nw <= chunk:
+            words = one_chunk(cargs)
+        else:
+            pad = (-nw) % chunk
+
+            def padq(a):
+                if pad:
+                    a = jnp.concatenate([a, jnp.zeros(pad, a.dtype)])
+                return a.reshape(-1, chunk)
+
+            words = jax.lax.map(
+                one_chunk, tuple(padq(a) for a in cargs)
+            ).reshape(-1, FastTable.WORDS)[:nw]
+
+        flat = words.ravel()  # (NW*4,) i32
+        nz = flat != 0
+        pos = jnp.cumsum(nz.astype(jnp.int32))
+        n_words = pos[-1]
+        # compact: scatter word index + bits into max_words slots
+        dst = jnp.where(nz, pos - 1, max_words)
+        wordpos = (
+            jnp.zeros((max_words + 1,), jnp.int32)
+            .at[dst]
+            .set(jnp.arange(flat.shape[0], dtype=jnp.int32), mode="drop")[
+                :max_words
+            ]
+        )
+        bits = (
+            jnp.zeros((max_words + 1,), jnp.int32)
+            .at[dst]
+            .set(flat, mode="drop")[:max_words]
+        )
+        return jnp.concatenate([n_words[None], wordpos, bits])
+
+    # -- host window expansion (shared by legacy + fused paths) --------------
+
+    def _expand_windows(self, qkeys: np.ndarray):
+        """(query, cell) pairs -> every 128-block their postings runs
+        touch.  Returns (win_q, win_key, win_blk, win_start, win_end)
+        host i32 arrays; [start, end) is the run's lane slice within
+        the window's block."""
+        B, W = qkeys.shape
+        qk = np.ascontiguousarray(qkeys, np.int32)
+        lo = np.searchsorted(self.host_key, qk.ravel(), side="left")
+        hi = np.searchsorted(self.host_key, qk.ravel(), side="right")
+        nonempty = hi > lo  # also drops pad cells (-1)
+        lo, hi = lo[nonempty], hi[nonempty]
+        flat_q = np.repeat(np.arange(B), W)[nonempty]
+        flat_k = qk.ravel()[nonempty]
+        first_blk = lo // BLOCK
+        n_blocks = (hi - 1) // BLOCK - first_blk + 1  # >= 1
+        win_q = np.repeat(flat_q, n_blocks).astype(np.int32)
+        win_key = np.repeat(flat_k, n_blocks)
+        starts = np.repeat(first_blk, n_blocks)
+        intra = np.arange(len(win_q)) - np.repeat(
+            np.cumsum(n_blocks) - n_blocks, n_blocks
+        )
+        win_blk = (starts + intra).astype(np.int32)
+        blk0 = win_blk.astype(np.int64) * BLOCK
+        win_start = np.maximum(np.repeat(lo, n_blocks) - blk0, 0).astype(np.int32)
+        win_end = np.minimum(np.repeat(hi, n_blocks) - blk0, BLOCK).astype(np.int32)
+        return win_q, win_key, win_blk, win_start, win_end
+
+    def _pack_windows(self, qkeys: np.ndarray):
+        """Expand + pack windows for the fused kernel: one (2, bucket)
+        i32 upload [blk, start|end<<8|qidx<<16].  Returns
+        (wins, win_q, win_blk, nw); nw == 0 means no work."""
+        win_q, _, win_blk, win_start, win_end = self._expand_windows(qkeys)
+        nw = len(win_blk)
+        if nw == 0:
+            return None, win_q, win_blk, 0
+        if len(qkeys) > (1 << 15):
+            # qidx lives in bits 16-31 of a signed i32 meta word; 2^15
+            # keeps the sign bit clear so meta >> 16 recovers it intact
+            raise ValueError("fused path supports batches up to 32768")
+        bucket = 256
+        while bucket < nw:
+            bucket *= 2
+        wins = np.zeros((2, bucket), np.int32)
+        wins[0, :nw] = win_blk
+        # pad rows keep meta 0 -> start == end == 0 -> no lanes match
+        wins[1, :nw] = win_start | (win_end << 8) | (win_q << 16)
+        return wins, win_q, win_blk, nw
+
+    def submit(
+        self,
+        qkeys: np.ndarray,  # i32[B, W] DAR keys, pad -1
+        alt_lo: np.ndarray,  # f32[B] (-inf if unbounded)
+        alt_hi: np.ndarray,
+        t_start: np.ndarray,  # i64[B] ns (NO_TIME_LO if unbounded)
+        t_end: np.ndarray,
+        *,
+        now: int,
+        max_words: int = 1 << 16,
+    ) -> Optional[PendingBatch]:
+        """Enqueue one fused query batch (async; no device sync).
+        Requires slot_exact.  Returns None when no query key has any
+        postings (empty result)."""
+        assert self.slot_exact is not None, "submit() requires slot_exact"
+        wins, win_q, win_blk, nw = self._pack_windows(qkeys)
+        if nw == 0:
+            return None
+
+        out = self._fused_xla(
+            self.b_alo,
+            self.b_ahi,
+            self.b_t0,
+            self.b_t1,
+            jnp.asarray(wins),
+            jnp.asarray(np.asarray(alt_lo, np.float32)),
+            jnp.asarray(np.asarray(alt_hi, np.float32)),
+            jnp.asarray(np.asarray(t_start, np.int64)),
+            jnp.asarray(np.asarray(t_end, np.int64)),
+            jnp.int64(now),
+            max_words=max_words,
+        )
+        try:
+            out.copy_to_host_async()
+        except Exception:
+            pass  # interpret/older backends: collect() just blocks
+        return PendingBatch(
+            out,
+            win_q,
+            win_blk,
+            (qkeys, alt_lo, alt_hi, t_start, t_end, now),
+            nw,
+            max_words,
+        )
+
+    def collect(
+        self, pending: Optional[PendingBatch]
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Resolve a submitted batch -> (qidx i64[H], slots i64[H]),
+        exact (not deduped).  The one host sync per batch."""
+        if pending is None:
+            return np.zeros(0, np.int64), np.zeros(0, np.int64)
+        out = np.asarray(pending.out)
+        mw = pending.max_words
+        n_words = int(out[0])
+        if n_words > mw:
+            # overflow: the word buffer was too small — rerun via the
+            # legacy full-mask path (exact same semantics)
+            qkeys, alt_lo, alt_hi, t_start, t_end, now = pending.host_inputs
+            qidx, offs = self.query_batch(
+                qkeys, alt_lo, alt_hi, t_start, t_end, now=now
+            )
+            se = self.slot_exact
+            return self.exact_filter(
+                qidx, offs,
+                records_alt_lo=se["alt_lo"],
+                records_alt_hi=se["alt_hi"],
+                records_t0=se["t0"],
+                records_t1=se["t1"],
+                records_live=se["live"],
+                alt_lo=alt_lo, alt_hi=alt_hi,
+                t_start=t_start, t_end=t_end, now=now,
+            )
+        wordpos = out[1 : 1 + n_words]
+        bits = out[1 + mw : 1 + mw + n_words].astype(np.int32)
+        if n_words == 0:
+            return np.zeros(0, np.int64), np.zeros(0, np.int64)
+        # expand hit words -> (word, bit) pairs
+        bytes_v = bits.view(np.uint8).reshape(-1, 4)
+        expanded = np.unpackbits(bytes_v, axis=1, bitorder="little")
+        wi, bitpos = np.nonzero(expanded)
+        win = wordpos[wi] // FastTable.WORDS
+        lane = (wordpos[wi] % FastTable.WORDS) * 32 + bitpos
+        offs = pending.win_blk[win].astype(np.int64) * BLOCK + lane
+        ok = offs < self.n_postings
+        offs = offs[ok]
+        slots = self.host_ent[offs].astype(np.int64)
+        qidx = pending.win_q[win[ok]].astype(np.int64)
+        # post-build tombstones (mark_dead) are dropped here
+        alive = self.slot_exact["live"][slots]
+        return qidx[alive], slots[alive]
+
+    def query_fused(
+        self, qkeys, alt_lo, alt_hi, t_start, t_end, *, now,
+        max_words: int = 1 << 16,
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """submit + collect in one call -> exact (qidx, slots)."""
+        return self.collect(
+            self.submit(
+                qkeys, alt_lo, alt_hi, t_start, t_end,
+                now=now, max_words=max_words,
+            )
+        )
+
     # -- the full query pipeline ---------------------------------------------
 
     def query_batch(
@@ -201,27 +516,10 @@ class FastTable:
         """-> (query_index i64[H], posting_offset i64[H]): the raw hit
         pairs after the conservative device filter.  Callers re-check
         exact attributes per hit (see exact_filter)."""
-        B, W = qkeys.shape
-        qk = np.ascontiguousarray(qkeys, np.int32)
-
         # host range lookup: expand every (query, cell) run into ALL
         # the 128-blocks it touches, so hot cells with arbitrarily long
         # runs are fully covered (no window-size false negatives)
-        lo = np.searchsorted(self.host_key, qk.ravel(), side="left")
-        hi = np.searchsorted(self.host_key, qk.ravel(), side="right")
-        nonempty = hi > lo  # also drops pad cells (-1)
-        lo, hi = lo[nonempty], hi[nonempty]
-        flat_q = np.repeat(np.arange(B), W)[nonempty]
-        flat_k = qk.ravel()[nonempty]
-        first_blk = lo // BLOCK
-        n_blocks = (hi - 1) // BLOCK - first_blk + 1  # >= 1
-        win_q = np.repeat(flat_q, n_blocks)
-        win_key = np.repeat(flat_k, n_blocks)
-        starts = np.repeat(first_blk, n_blocks)
-        intra = np.arange(len(win_q)) - np.repeat(
-            np.cumsum(n_blocks) - n_blocks, n_blocks
-        )
-        win_blk = (starts + intra).astype(np.int32)
+        win_q, win_key, win_blk, _, _ = self._expand_windows(qkeys)
         if len(win_blk) == 0:
             return np.zeros(0, np.int64), np.zeros(0, np.int64)
 

@@ -17,7 +17,7 @@ NOW = 1_700_000_000_000_000_000
 HOUR = 3_600_000_000_000
 
 
-def _mk_table(rng, n, key_space=400):
+def _mk_table(rng, n, key_space=400, slot_exact=False):
     recs = []
     for i in range(n):
         nk = int(rng.integers(1, 10))
@@ -45,6 +45,15 @@ def _mk_table(rng, n, key_space=400):
     pe = np.asarray(pe, np.int32)
     order = np.argsort(pk, kind="stable")
     pk, pe = pk[order], pe[order]
+    se = None
+    if slot_exact:
+        se = dict(
+            alt_lo=np.asarray([r.alt_lo for r in recs], np.float32),
+            alt_hi=np.asarray([r.alt_hi for r in recs], np.float32),
+            t0=np.asarray([r.t_start for r in recs], np.int64),
+            t1=np.asarray([r.t_end for r in recs], np.int64),
+            live=np.ones(len(recs), bool),
+        )
     ft = FastTable(
         pk,
         pe,
@@ -53,6 +62,7 @@ def _mk_table(rng, n, key_space=400):
         np.asarray([recs[s].t_start for s in pe], np.int64),
         np.asarray([recs[s].t_end for s in pe], np.int64),
         np.ones(len(pe), bool),
+        slot_exact=se,
     )
     return recs, ft
 
@@ -111,6 +121,79 @@ def test_fastpath_matches_oracle(use_pallas):
         )
         got = sorted(set(slots[qidx == i].tolist()))
         assert got == want, f"query {i} (pallas={use_pallas})"
+
+
+@pytest.mark.parametrize("max_words", [1 << 14, 64, 8])
+def test_fused_path_matches_oracle(max_words):
+    """The fused on-device decode path (submit/collect) must produce
+    exactly the oracle result sets, including when the compaction
+    buffer overflows (max_words small -> legacy-path fallback)."""
+    rng = np.random.default_rng(43)
+    recs, ft = _mk_table(rng, 250, slot_exact=True)
+    B, W = 8, 16
+    qkeys = np.full((B, W), -1, np.int32)
+    alo = np.full(B, -np.inf, np.float32)
+    ahi = np.full(B, np.inf, np.float32)
+    ts = np.full(B, NO_TIME_LO, np.int64)
+    te = np.full(B, NO_TIME_HI, np.int64)
+    for i in range(B):
+        nk = int(rng.integers(1, W))
+        u = np.unique(rng.integers(0, 400, nk).astype(np.int32))
+        qkeys[i, : len(u)] = u
+        if i % 2:
+            a, b = sorted(rng.uniform(0, 3000, 2))
+            alo[i], ahi[i] = a, b
+        if i % 3:
+            ts[i] = NOW - 2 * HOUR
+            te[i] = NOW + 2 * HOUR
+
+    qidx, slots = ft.query_fused(
+        qkeys, alo, ahi, ts, te, now=NOW, max_words=max_words
+    )
+    recs_map = dict(enumerate(recs))
+    for i in range(B):
+        want = sorted(
+            oracle.search(
+                recs_map,
+                qkeys[i][qkeys[i] >= 0],
+                None if alo[i] == -np.inf else float(alo[i]),
+                None if ahi[i] == np.inf else float(ahi[i]),
+                None if ts[i] == NO_TIME_LO else int(ts[i]),
+                None if te[i] == NO_TIME_HI else int(te[i]),
+                NOW,
+            )
+        )
+        got = sorted(set(slots[qidx == i].tolist()))
+        assert got == want, f"query {i} (max_words={max_words})"
+
+
+def test_fused_pipelined_submit_collect():
+    """Many batches in flight at once resolve to the same results as
+    one-at-a-time execution."""
+    rng = np.random.default_rng(44)
+    recs, ft = _mk_table(rng, 300, slot_exact=True)
+    batches = []
+    for b in range(6):
+        B, W = 4, 16
+        qkeys = np.full((B, W), -1, np.int32)
+        for i in range(B):
+            u = np.unique(rng.integers(0, 400, 8).astype(np.int32))
+            qkeys[i, : len(u)] = u
+        alo = np.full(B, -np.inf, np.float32)
+        ahi = np.full(B, np.inf, np.float32)
+        ts = np.full(B, NOW - HOUR, np.int64)
+        te = np.full(B, NOW + HOUR, np.int64)
+        batches.append((qkeys, alo, ahi, ts, te))
+
+    serial = [
+        ft.query_fused(*b, now=NOW) for b in batches
+    ]
+    pendings = [ft.submit(*b, now=NOW) for b in batches]
+    for (sq, ss), p in zip(serial, pendings):
+        pq, ps = ft.collect(p)
+        assert sorted(zip(sq.tolist(), ss.tolist())) == sorted(
+            zip(pq.tolist(), ps.tolist())
+        )
 
 
 @pytest.mark.parametrize("use_pallas", [False, True])
