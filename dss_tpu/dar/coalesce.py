@@ -1,0 +1,131 @@
+"""Micro-batching query coalescer for DarTable.
+
+The serving-stack glue between request-per-thread handlers and the
+batched fused kernel: concurrent callers enqueue single queries; one
+worker thread drains whatever is queued and runs it as ONE
+DarTable.query_many batch.  Continuous batching — no timing window:
+
+  - a lone caller runs immediately as a batch of 1 (no added latency),
+  - while a batch is on the device, new arrivals queue up and form the
+    next batch, so concurrency N collapses to ~1 kernel per round trip
+    instead of N round trips.
+
+This replaces the reference's per-request SQL round trip to CRDB
+(goroutine-per-RPC, pkg/rid/cockroach/identification_service_area.go
+:166-197) with the TPU-idiomatic shape: request parallelism becomes
+data parallelism over the query batch axis.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+from dss_tpu.ops.conflict import NO_TIME_HI, NO_TIME_LO
+
+_MAX_BATCH = 4096
+
+
+class _Item:
+    __slots__ = ("keys", "alt_lo", "alt_hi", "t_start", "t_end", "now",
+                 "owner_id", "event", "result", "error")
+
+    def __init__(self, keys, alt_lo, alt_hi, t_start, t_end, now, owner_id):
+        self.keys = keys
+        self.alt_lo = -np.inf if alt_lo is None else float(alt_lo)
+        self.alt_hi = np.inf if alt_hi is None else float(alt_hi)
+        self.t_start = NO_TIME_LO if t_start is None else int(t_start)
+        self.t_end = NO_TIME_HI if t_end is None else int(t_end)
+        self.now = int(now)
+        self.owner_id = -1 if owner_id is None else int(owner_id)
+        self.event = threading.Event()
+        self.result: Optional[List[str]] = None
+        self.error: Optional[BaseException] = None
+
+
+class QueryCoalescer:
+    """One worker thread per DarTable, batching concurrent queries."""
+
+    def __init__(self, table):
+        self._table = table
+        self._cond = threading.Condition()
+        self._queue: List[_Item] = []
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+
+    def _ensure_thread(self):
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._run, name="dar-coalescer", daemon=True
+            )
+            self._thread.start()
+
+    def query(
+        self,
+        keys: np.ndarray,
+        alt_lo=None,
+        alt_hi=None,
+        t_start=None,
+        t_end=None,
+        *,
+        now: int,
+        owner_id=None,
+    ) -> List[str]:
+        """Blocking single query, executed as part of a micro-batch."""
+        keys = np.asarray(keys, np.int32).ravel()
+        if len(keys) == 0:
+            return []
+        item = _Item(keys, alt_lo, alt_hi, t_start, t_end, now, owner_id)
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("coalescer is closed")
+            self._queue.append(item)
+            self._ensure_thread()
+            self._cond.notify()
+        item.event.wait()
+        if item.error is not None:
+            raise item.error
+        return item.result
+
+    def close(self):
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    # -- worker --------------------------------------------------------------
+
+    def _run(self):
+        while True:
+            with self._cond:
+                while not self._queue and not self._closed:
+                    self._cond.wait()
+                if self._closed and not self._queue:
+                    return
+                batch = self._queue[:_MAX_BATCH]
+                del self._queue[:_MAX_BATCH]
+            self._execute(batch)
+
+    def _execute(self, batch: List[_Item]):
+        try:
+            b = len(batch)
+            results = self._table.query_many(
+                [it.keys for it in batch],
+                np.asarray([it.alt_lo for it in batch], np.float32),
+                np.asarray([it.alt_hi for it in batch], np.float32),
+                np.asarray([it.t_start for it in batch], np.int64),
+                np.asarray([it.t_end for it in batch], np.int64),
+                now=np.asarray([it.now for it in batch], np.int64),
+                owner_ids=np.asarray(
+                    [it.owner_id for it in batch], np.int32
+                ),
+            )
+            for it, res in zip(batch, results):
+                it.result = res
+                it.event.set()
+        except BaseException as e:  # noqa: BLE001 — deliver to callers
+            for it in batch:
+                if not it.event.is_set():
+                    it.error = e
+                    it.event.set()

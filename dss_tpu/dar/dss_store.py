@@ -103,9 +103,10 @@ class RIDStoreImpl(RIDStore):
         return self._sub_index.stats()
 
     def get_isa(self, id):
-        with self._lock:
-            isa = self._isas.get(id)
-            return dataclasses.replace(isa) if isa else None
+        # lock-free read: dict get is atomic; records are replaced, not
+        # mutated, on write
+        isa = self._isas.get(id)
+        return dataclasses.replace(isa) if isa else None
 
     def _index_isa(self, isa):
         self._isa_index.put(
@@ -153,26 +154,30 @@ class RIDStoreImpl(RIDStore):
             return dataclasses.replace(old)
 
     def search_isas(self, cells, earliest, latest):
-        with self._lock:
-            if len(np.asarray(cells).ravel()) == 0:
-                raise errors.bad_request("missing cell IDs for query")
-            if earliest is None:
-                raise errors.internal("must call with an earliest start time.")
-            e_ns = to_nanos(earliest)
-            ids = self._isa_index.query_ids(
-                cells,
-                t_start=e_ns,
-                t_end=None if latest is None else to_nanos(latest),
-                now=e_ns,
-            )
-            return [dataclasses.replace(self._isas[i]) for i in ids if i in self._isas]
+        # lock-free read against the index's published snapshot
+        if len(np.asarray(cells).ravel()) == 0:
+            raise errors.bad_request("missing cell IDs for query")
+        if earliest is None:
+            raise errors.internal("must call with an earliest start time.")
+        e_ns = to_nanos(earliest)
+        ids = self._isa_index.query_ids(
+            cells,
+            t_start=e_ns,
+            t_end=None if latest is None else to_nanos(latest),
+            now=e_ns,
+        )
+        out = []
+        for i in ids:
+            isa = self._isas.get(i)
+            if isa is not None:
+                out.append(dataclasses.replace(isa))
+        return out
 
     # -- Subscriptions -------------------------------------------------------
 
     def get_subscription(self, id):
-        with self._lock:
-            sub = self._subs.get(id)
-            return dataclasses.replace(sub) if sub else None
+        sub = self._subs.get(id)
+        return dataclasses.replace(sub) if sub else None
 
     def _index_sub(self, sub):
         self._sub_index.put(
@@ -220,26 +225,33 @@ class RIDStoreImpl(RIDStore):
             return dataclasses.replace(old)
 
     def search_subscriptions(self, cells):
-        with self._lock:
-            if len(np.asarray(cells).ravel()) == 0:
-                raise errors.bad_request("no location provided")
-            ids = self._sub_index.query_ids(cells, now=self._now_ns())
-            return [dataclasses.replace(self._subs[i]) for i in ids if i in self._subs]
+        if len(np.asarray(cells).ravel()) == 0:
+            raise errors.bad_request("no location provided")
+        ids = self._sub_index.query_ids(cells, now=self._now_ns())
+        out = []
+        for i in ids:
+            sub = self._subs.get(i)
+            if sub is not None:
+                out.append(dataclasses.replace(sub))
+        return out
 
     def search_subscriptions_by_owner(self, cells, owner):
-        with self._lock:
-            if len(np.asarray(cells).ravel()) == 0:
-                raise errors.bad_request("no location provided")
-            ids = self._sub_index.query_ids(
-                cells, now=self._now_ns(), owner_id=self._owners.intern(owner)
-            )
-            return [dataclasses.replace(self._subs[i]) for i in ids if i in self._subs]
+        if len(np.asarray(cells).ravel()) == 0:
+            raise errors.bad_request("no location provided")
+        ids = self._sub_index.query_ids(
+            cells, now=self._now_ns(), owner_id=self._owners.intern(owner)
+        )
+        out = []
+        for i in ids:
+            sub = self._subs.get(i)
+            if sub is not None:
+                out.append(dataclasses.replace(sub))
+        return out
 
     def max_subscription_count_in_cells_by_owner(self, cells, owner):
-        with self._lock:
-            return self._sub_index.max_owner_count(
-                cells, self._owners.intern(owner), now=self._now_ns()
-            )
+        return self._sub_index.max_owner_count(
+            cells, self._owners.intern(owner), now=self._now_ns()
+        )
 
     def update_notification_idxs_in_cells(self, cells):
         with self._lock:
@@ -321,11 +333,10 @@ class SCDStoreImpl(SCDStore):
     # -- Operations ----------------------------------------------------------
 
     def get_operation(self, id):
-        with self._lock:
-            op = self._visible_op(id)
-            if op is None:
-                raise errors.not_found(id)
-            return dataclasses.replace(op)
+        op = self._visible_op(id)
+        if op is None:
+            raise errors.not_found(id)
+        return dataclasses.replace(op)
 
     def _index_op(self, op):
         self._op_index.put(
@@ -349,7 +360,7 @@ class SCDStoreImpl(SCDStore):
             self._owners.intern(sub.owner),
         )
 
-    def _search_ops_locked(self, cells, alt_lo, alt_hi, earliest, latest):
+    def _search_ops(self, cells, alt_lo, alt_hi, earliest, latest):
         ids = self._op_index.query_ids(
             cells,
             alt_lo=alt_lo,
@@ -358,13 +369,19 @@ class SCDStoreImpl(SCDStore):
             t_end=None if latest is None else to_nanos(latest),
             now=self._now_ns(),
         )
-        return [dataclasses.replace(self._ops[i]) for i in sorted(ids) if i in self._ops]
+        # .get(): a concurrent delete between the index query and this
+        # assembly must skip, not KeyError (reads are lock-free)
+        out = []
+        for i in sorted(ids):
+            op = self._ops.get(i)
+            if op is not None:
+                out.append(dataclasses.replace(op))
+        return out
 
     def search_operations(self, cells, alt_lo, alt_hi, earliest, latest):
-        with self._lock:
-            if len(np.asarray(cells).ravel()) == 0:
-                raise errors.bad_request("missing cell IDs for query")
-            return self._search_ops_locked(cells, alt_lo, alt_hi, earliest, latest)
+        if len(np.asarray(cells).ravel()) == 0:
+            raise errors.bad_request("missing cell IDs for query")
+        return self._search_ops(cells, alt_lo, alt_hi, earliest, latest)
 
     def _notify_subs_locked(self, cells) -> List[scdm.Subscription]:
         """Bump + return live subscriptions intersecting cells
@@ -397,7 +414,7 @@ class SCDStoreImpl(SCDStore):
             op.validate_time_range()
 
             if op.state in scdm.OperationState.REQUIRES_KEY:
-                conflicting = self._search_ops_locked(
+                conflicting = self._search_ops(
                     op.cells,
                     op.altitude_lower,
                     op.altitude_upper,
@@ -449,25 +466,24 @@ class SCDStoreImpl(SCDStore):
 
     # -- Subscriptions -------------------------------------------------------
 
-    def _dependent_ops_locked(self, sub) -> List[str]:
+    def _dependent_ops(self, sub) -> List[str]:
         """The reference populates DependentOperations with the ids of
         operations intersecting the subscription's own 4D volume
         (subscriptions.go:212-249)."""
         if len(np.asarray(sub.cells).ravel()) == 0:
             return []
-        ops = self._search_ops_locked(
+        ops = self._search_ops(
             sub.cells, sub.altitude_lo, sub.altitude_hi, sub.start_time, sub.end_time
         )
         return [o.id for o in ops]
 
     def get_subscription(self, id, owner):
-        with self._lock:
-            sub = self._visible_sub(id)
-            if sub is None or sub.owner != owner:
-                raise errors.not_found(id)
-            out = dataclasses.replace(sub)
-            out.dependent_operations = self._dependent_ops_locked(sub)
-            return out
+        sub = self._visible_sub(id)
+        if sub is None or sub.owner != owner:
+            raise errors.not_found(id)
+        out = dataclasses.replace(sub)
+        out.dependent_operations = self._dependent_ops(sub)
+        return out
 
     def upsert_subscription(self, sub):
         with self._lock:
@@ -497,7 +513,7 @@ class SCDStoreImpl(SCDStore):
             self._index_scd_sub(stored)
             self._journal({"t": "scd_sub_put", "doc": codec.scd_sub_to_doc(stored)})
             affected = (
-                self._search_ops_locked(
+                self._search_ops(
                     stored.cells,
                     stored.altitude_lo,
                     stored.altitude_hi,
@@ -534,21 +550,20 @@ class SCDStoreImpl(SCDStore):
         which in effect ignores the cell filter; we implement the
         intended inner-join semantics (cells do filter).
         """
-        with self._lock:
-            if len(np.asarray(cells).ravel()) == 0:
-                raise errors.bad_request("no location provided")
-            ids = self._sub_index.query_ids(
-                cells, now=self._now_ns(), owner_id=self._owners.intern(owner)
-            )
-            out = []
-            for i in sorted(ids):
-                sub = self._subs.get(i)
-                if sub is None:
-                    continue
-                s = dataclasses.replace(sub)
-                s.dependent_operations = self._dependent_ops_locked(sub)
-                out.append(s)
-            return out
+        if len(np.asarray(cells).ravel()) == 0:
+            raise errors.bad_request("no location provided")
+        ids = self._sub_index.query_ids(
+            cells, now=self._now_ns(), owner_id=self._owners.intern(owner)
+        )
+        out = []
+        for i in sorted(ids):
+            sub = self._subs.get(i)
+            if sub is None:
+                continue
+            s = dataclasses.replace(sub)
+            s.dependent_operations = self._dependent_ops(sub)
+            out.append(s)
+        return out
 
     # -- WAL replay ----------------------------------------------------------
 

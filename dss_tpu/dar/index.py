@@ -17,6 +17,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from dss_tpu.dar import oracle
+from dss_tpu.dar.coalesce import QueryCoalescer
 from dss_tpu.dar.oracle import Record
 from dss_tpu.dar.snapshot import DarTable
 from dss_tpu.geo import s2cell
@@ -75,6 +76,9 @@ class MemorySpatialIndex:
 class TpuSpatialIndex:
     def __init__(self, **table_kwargs):
         self._table = DarTable(**table_kwargs)
+        # concurrent readers (one thread per in-flight request) are
+        # micro-batched into single fused kernel launches
+        self._coalescer = QueryCoalescer(self._table)
 
     def put(self, id, cells_u64, alt_lo, alt_hi, t_start, t_end, owner_id):
         self._table.upsert(
@@ -95,7 +99,7 @@ class TpuSpatialIndex:
         now,
         owner_id=None,
     ) -> List[str]:
-        return self._table.query(
+        return self._coalescer.query(
             _to_keys(cells_u64),
             alt_lo,
             alt_hi,

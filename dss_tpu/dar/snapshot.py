@@ -6,137 +6,154 @@ tables for SCD, pkg/scd/store/cockroach/store.go:92-151).  One DarTable
 holds one entity class (ISAs, RID subscriptions, SCD operations, SCD
 subscriptions).
 
-Host side keeps the authoritative Record per slot; the device holds the
-packed EntityTable + sorted base Postings + a small sorted delta
-overlay.  Writes are synchronous: a new slot is allocated per entity
-version (append-only; the old slot is tombstoned), its postings go to
-the delta, and the delta is merged into the base when full.  Queries
-run the batched JAX kernel; a result-width overflow falls back to the
-exact numpy oracle.
+LSM-shaped for lock-free reads (the MVCC-concurrency analog of CRDB
+snapshot reads).  ALL state a reader touches is published as ONE
+immutable `_State` object, swapped atomically by reference assignment:
+
+  - `snap`: the device snapshot — a FastTable (resident packed postings
+    + exact attribute columns, dss_tpu.ops.fastpath) plus host-side
+    slot->id/owner maps.  Device/host arrays inside a snapshot are
+    never mutated after publication.
+  - `overlay`: records written since the snapshot build, packed into
+    small sorted numpy postings for a vectorized host scan.
+  - `dead`: snapshot slots superseded or removed since the build;
+    readers drop them after the fused query.  (The FastTable's own
+    mark_dead is NOT used here — mutating the shared live column would
+    race in-flight readers that captured an older overlay.)
+
+A reader therefore sees a consistent (snapshot, overlay, dead) triple:
+an entity live at the time the reader grabbed the state is visible via
+exactly the snapshot or the overlay; an entity updated by a concurrent
+writer is visible as exactly one of its versions.
+
+When the overlay exceeds `delta_capacity` postings, the writer folds
+everything into a fresh snapshot (readers keep using the old state
+until the atomic swap).
+
+Queries run the batched fused kernel; many concurrent requests are
+micro-batched by dss_tpu.dar.coalesce.QueryCoalescer.
 """
 
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Optional
+from typing import Dict, List, NamedTuple, Optional
 
-import jax
-import jax.numpy as jnp
 import numpy as np
 
-from dss_tpu.dar import oracle
 from dss_tpu.dar.oracle import Record
 from dss_tpu.dar.pack import pack_records, pow2_at_least
+from dss_tpu.ops.conflict import NO_TIME_HI, NO_TIME_LO
 from dss_tpu.ops.fastpath import FastTable
-from dss_tpu.ops.conflict import (
-    INT32_MAX,
-    NO_TIME_HI,
-    NO_TIME_LO,
-    EntityTable,
-    Postings,
-    QuerySpec,
-    conflict_query_batch,
-    max_count_per_cell as _kernel_max_count,
-)
-
-_QUERY_BUCKETS = (64, 256, 1024, 4096)
-_DELTA_PER_KEY_CAP = 64
 
 
-def _bucket(n: int, buckets=_QUERY_BUCKETS) -> int:
-    for b in buckets:
-        if n <= b:
-            return b
-    raise ValueError(f"query too wide: {n} cells (max {buckets[-1]})")
+class _Snapshot(NamedTuple):
+    fast: Optional[FastTable]
+    owner: Optional[np.ndarray]  # i32 per slot
+    ids: List[str]  # slot -> entity_id
+    slot_of: Dict[str, int]  # entity_id -> slot
 
 
-@jax.jit
-def _set_entity_row(ents: EntityTable, slot, alt_lo, alt_hi, t_start, t_end, active, owner):
-    return EntityTable(
-        alt_lo=ents.alt_lo.at[slot].set(alt_lo),
-        alt_hi=ents.alt_hi.at[slot].set(alt_hi),
-        t_start=ents.t_start.at[slot].set(t_start),
-        t_end=ents.t_end.at[slot].set(t_end),
-        active=ents.active.at[slot].set(active),
-        owner=ents.owner.at[slot].set(owner),
+class _Overlay(NamedTuple):
+    """Records since the snapshot build, packed for a vectorized scan
+    (the host-side analog of the device postings layout)."""
+
+    ids: List[str]  # local index -> entity_id
+    key: np.ndarray  # i32[P] sorted
+    ent: np.ndarray  # i32[P] local index per posting
+    alt_lo: np.ndarray  # f32[n]
+    alt_hi: np.ndarray  # f32[n]
+    t0: np.ndarray  # i64[n]
+    t1: np.ndarray  # i64[n]
+    owner: np.ndarray  # i32[n]
+
+
+class _State(NamedTuple):
+    snap: _Snapshot
+    pending: Dict[str, Record]  # overlay source records (immutable)
+    overlay: Optional[_Overlay]  # packed form of pending (None if empty)
+    dead: frozenset  # snapshot slots superseded/removed since build
+
+
+_EMPTY_SNAPSHOT = _Snapshot(None, None, [], {})
+_EMPTY_STATE = _State(_EMPTY_SNAPSHOT, {}, None, frozenset())
+
+
+def _pack_overlay(pending: Dict[str, Record]) -> Optional[_Overlay]:
+    if not pending:
+        return None
+    recs = list(pending.values())
+    ids = [r.entity_id for r in recs]
+    key = np.concatenate([r.keys for r in recs]).astype(np.int32)
+    ent = np.repeat(
+        np.arange(len(recs), dtype=np.int32),
+        [len(r.keys) for r in recs],
+    )
+    order = np.argsort(key, kind="stable")
+    return _Overlay(
+        ids=ids,
+        key=key[order],
+        ent=ent[order],
+        alt_lo=np.asarray([r.alt_lo for r in recs], np.float32),
+        alt_hi=np.asarray([r.alt_hi for r in recs], np.float32),
+        t0=np.asarray([r.t_start for r in recs], np.int64),
+        t1=np.asarray([r.t_end for r in recs], np.int64),
+        owner=np.asarray([r.owner_id for r in recs], np.int32),
     )
 
 
-@jax.jit
-def _tombstone_row(ents: EntityTable, slot):
-    return EntityTable(
-        alt_lo=ents.alt_lo,
-        alt_hi=ents.alt_hi,
-        t_start=ents.t_start,
-        t_end=ents.t_end,
-        active=ents.active.at[slot].set(False),
-        owner=ents.owner,
+def _overlay_search(
+    ov: _Overlay,
+    qkeys: np.ndarray,  # i32[B, W] pad -1
+    alt_lo, alt_hi, t_start, t_end,  # per-query arrays
+    now_arr: np.ndarray,
+    owner_ids: Optional[np.ndarray],
+):
+    """Vectorized host scan of the overlay -> (qidx, local_ent) pairs."""
+    B, W = qkeys.shape
+    flat = qkeys.ravel()
+    lo = np.searchsorted(ov.key, flat, side="left")
+    hi = np.searchsorted(ov.key, flat, side="right")
+    n = hi - lo
+    nonempty = n > 0
+    lo, n = lo[nonempty], n[nonempty]
+    flat_q = np.repeat(np.arange(B), W)[nonempty]
+    total = int(n.sum())
+    if total == 0:
+        return np.zeros(0, np.int64), np.zeros(0, np.int64)
+    base = np.repeat(lo, n)
+    intra = np.arange(total) - np.repeat(np.cumsum(n) - n, n)
+    cand = ov.ent[base + intra]
+    cq = np.repeat(flat_q, n)
+    keep = (
+        (ov.alt_hi[cand] >= alt_lo[cq])
+        & (ov.alt_lo[cand] <= alt_hi[cq])
+        & (ov.t1[cand] >= np.maximum(t_start[cq], now_arr[cq]))
+        & (ov.t0[cand] <= t_end[cq])
     )
+    if owner_ids is not None:
+        keep &= (owner_ids[cq] < 0) | (ov.owner[cand] == owner_ids[cq])
+    return cq[keep].astype(np.int64), cand[keep].astype(np.int64)
 
 
 class DarTable:
-    """Thread-safe HBM spatial index for one entity class."""
+    """HBM spatial index for one entity class: lock-free reads against
+    the published immutable state; copy-on-write writes."""
 
     def __init__(
         self,
         *,
-        max_results: int = 512,
+        max_results: int = 512,  # kept for API compat; fused path has
+        #                          no fixed result width
         delta_capacity: int = 8192,
-        entity_capacity: int = 1024,
+        entity_capacity: int = 1024,  # kept for API compat; slots are
+        #                               assigned per snapshot build
     ):
-        self._lock = threading.RLock()
-        self.max_results = max_results
-        self.delta_capacity = delta_capacity
-
-        # host authoritative state
-        self.records: Dict[int, Record] = {}  # slot -> live record
-        self.slot_of: Dict[str, int] = {}  # entity_id -> live slot
-        self._next_slot = 0
-        self._entity_capacity = entity_capacity
-
-        # host mirrors of postings
-        self._base_key = np.full(0, INT32_MAX, np.int32)
-        self._base_ent = np.full(0, 0, np.int32)
-        self.base_cap = 8
-        self._delta_key = np.full(delta_capacity, INT32_MAX, np.int32)
-        self._delta_ent = np.zeros(delta_capacity, np.int32)
-        self._delta_count = 0
-
-        # batch fast path (built lazily from the last rebuild)
-        self._host_cols = None
-        self._fast = None
-
-        # device state
-        self._ents = self._empty_entity_table(entity_capacity)
-        self._base = Postings(
-            post_key=jnp.full((8,), INT32_MAX, jnp.int32),
-            post_ent=jnp.full((8,), entity_capacity, jnp.int32),
-        )
-        self._push_delta()
-
-    # -- construction helpers ------------------------------------------------
-
-    def _empty_entity_table(self, capacity: int) -> EntityTable:
-        return EntityTable(
-            alt_lo=jnp.full((capacity + 1,), np.inf, jnp.float32),
-            alt_hi=jnp.full((capacity + 1,), -np.inf, jnp.float32),
-            t_start=jnp.full((capacity + 1,), NO_TIME_HI, jnp.int64),
-            t_end=jnp.full((capacity + 1,), NO_TIME_LO, jnp.int64),
-            active=jnp.zeros((capacity + 1,), jnp.bool_),
-            owner=jnp.full((capacity + 1,), -1, jnp.int32),
-        )
-
-    def _push_delta(self):
-        self._delta = Postings(
-            post_key=jnp.asarray(self._delta_key),
-            post_ent=jnp.asarray(
-                np.where(
-                    self._delta_key == INT32_MAX,
-                    self._entity_capacity,
-                    self._delta_ent,
-                ).astype(np.int32)
-            ),
-        )
+        del max_results, entity_capacity
+        self._write_lock = threading.RLock()
+        self._rebuild_postings = delta_capacity
+        self.records: Dict[str, Record] = {}  # authoritative, writer-owned
+        self._state: _State = _EMPTY_STATE
 
     # -- write path ----------------------------------------------------------
 
@@ -152,140 +169,94 @@ class DarTable:
     ) -> None:
         """Insert or replace an entity. keys are int32 DAR keys."""
         keys = np.unique(np.asarray(keys, dtype=np.int32))
-        with self._lock:
-            self._fast = None
-            old_slot = self.slot_of.pop(entity_id, None)
-            if old_slot is not None:
-                del self.records[old_slot]
-                self._ents = _tombstone_row(self._ents, old_slot)
-            if (
-                self._next_slot >= self._entity_capacity
-                or self._delta_count + len(keys) > self.delta_capacity
-            ):
-                self._rebuild_locked(
-                    pending=Record(
-                        entity_id=entity_id,
-                        keys=keys,
-                        alt_lo=-np.inf if alt_lo is None else float(alt_lo),
-                        alt_hi=np.inf if alt_hi is None else float(alt_hi),
-                        t_start=int(t_start),
-                        t_end=int(t_end),
-                        owner_id=int(owner_id),
-                    )
-                )
+        rec = Record(
+            entity_id=entity_id,
+            keys=keys,
+            alt_lo=-np.inf if alt_lo is None else float(alt_lo),
+            alt_hi=np.inf if alt_hi is None else float(alt_hi),
+            t_start=int(t_start),
+            t_end=int(t_end),
+            owner_id=int(owner_id),
+        )
+        with self._write_lock:
+            self.records[entity_id] = rec
+            st = self._state
+            pending = dict(st.pending)
+            pending[entity_id] = rec
+            slot = st.snap.slot_of.get(entity_id)
+            dead = st.dead if slot is None else st.dead | {slot}
+            if sum(len(r.keys) for r in pending.values()) > self._rebuild_postings:
+                self._rebuild_locked()
                 return
-            slot = self._next_slot
-            self._next_slot += 1
-            rec = Record(
-                entity_id=entity_id,
-                keys=keys,
-                alt_lo=-np.inf if alt_lo is None else float(alt_lo),
-                alt_hi=np.inf if alt_hi is None else float(alt_hi),
-                t_start=int(t_start),
-                t_end=int(t_end),
-                owner_id=int(owner_id),
+            # one atomic publish: snapshot + overlay + dead set together
+            self._state = _State(
+                st.snap, pending, _pack_overlay(pending), dead
             )
-            self.records[slot] = rec
-            self.slot_of[entity_id] = slot
-            self._ents = _set_entity_row(
-                self._ents,
-                slot,
-                jnp.float32(rec.alt_lo),
-                jnp.float32(rec.alt_hi),
-                jnp.int64(rec.t_start),
-                jnp.int64(rec.t_end),
-                True,
-                jnp.int32(rec.owner_id),
-            )
-            # append postings into the sorted delta
-            n = self._delta_count
-            self._delta_key[n : n + len(keys)] = keys
-            self._delta_ent[n : n + len(keys)] = slot
-            self._delta_count = n + len(keys)
-            order = np.argsort(self._delta_key[: self._delta_count], kind="stable")
-            self._delta_key[: self._delta_count] = self._delta_key[order]
-            self._delta_ent[: self._delta_count] = self._delta_ent[order]
-            # per-key run cap: if exceeded, fold delta into base
-            if self._delta_count:
-                dk = self._delta_key[: self._delta_count]
-                _, counts = np.unique(dk, return_counts=True)
-                if counts.max(initial=0) > _DELTA_PER_KEY_CAP:
-                    self._rebuild_locked()
-                    return
-            self._push_delta()
 
     def remove(self, entity_id: str) -> bool:
-        with self._lock:
-            slot = self.slot_of.pop(entity_id, None)
-            if slot is None:
+        with self._write_lock:
+            rec = self.records.pop(entity_id, None)
+            if rec is None:
                 return False
-            del self.records[slot]
-            self._ents = _tombstone_row(self._ents, slot)
-            if self._fast is not None:
-                # no rebuild needed: flip the FastTable's host live bit;
-                # collect() drops the slot during result assembly (the
-                # device columns are untouched until the next rebuild)
-                self._fast[0].mark_dead(slot)
+            st = self._state
+            pending = st.pending
+            if entity_id in pending:
+                pending = dict(pending)
+                del pending[entity_id]
+            slot = st.snap.slot_of.get(entity_id)
+            dead = st.dead if slot is None else st.dead | {slot}
+            self._state = _State(
+                st.snap, pending, _pack_overlay(pending), dead
+            )
             return True
 
-    def _rebuild_locked(self, pending: Optional[Record] = None):
-        """Compact slots and rebuild base postings from live records."""
+    def _rebuild_locked(self):
+        """Fold records into a fresh device snapshot and publish it."""
         live = list(self.records.values())
-        if pending is not None:
-            live.append(pending)
-        capacity = pow2_at_least(max(len(live), 1) * 2, lo=1024)
-        self._entity_capacity = capacity
-
-        self.records = dict(enumerate(live))
-        self.slot_of = {rec.entity_id: slot for slot, rec in self.records.items()}
-        self._next_slot = len(live)
-
-        packed = pack_records(live, capacity=capacity)
-        self.base_cap = packed.base_cap
-        self._base_key = packed.post_key
-        self._base_ent = packed.post_ent
-        self._host_cols = packed
-        self._fast = None
-
-        self._ents = EntityTable(
-            alt_lo=jnp.asarray(packed.alt_lo),
-            alt_hi=jnp.asarray(packed.alt_hi),
-            t_start=jnp.asarray(packed.t_start),
-            t_end=jnp.asarray(packed.t_end),
-            active=jnp.asarray(packed.active),
-            owner=jnp.asarray(packed.owner),
-        )
-        self._base = Postings(
-            post_key=jnp.asarray(packed.post_key),
-            post_ent=jnp.asarray(packed.post_ent),
-        )
-        self._delta_key[:] = INT32_MAX
-        self._delta_ent[:] = 0
-        self._delta_count = 0
-        self._push_delta()
+        if not live:
+            snap = _EMPTY_SNAPSHOT
+        else:
+            packed = pack_records(live, pad_postings=False)
+            pe = packed.post_ent
+            ft = FastTable(
+                packed.post_key,
+                pe,
+                packed.alt_lo[pe],
+                packed.alt_hi[pe],
+                packed.t_start[pe],
+                packed.t_end[pe],
+                packed.active[pe],
+                slot_exact={
+                    "alt_lo": packed.alt_lo,
+                    "alt_hi": packed.alt_hi,
+                    "t0": packed.t_start,
+                    "t1": packed.t_end,
+                    "live": packed.active.copy(),
+                },
+            )
+            ids = [r.entity_id for r in live]
+            snap = _Snapshot(
+                fast=ft,
+                owner=packed.owner,
+                ids=ids,
+                slot_of={eid: i for i, eid in enumerate(ids)},
+            )
+        self._state = _State(snap, {}, None, frozenset())
 
     def rebuild(self):
-        with self._lock:
+        with self._write_lock:
             self._rebuild_locked()
 
     def bulk_load(self, records) -> None:
         """Replace the table contents with `records` (list of Record) in
         one rebuild — the snapshot-refresh path (WAL replay / bench
-        population) that skips per-entity delta churn.  Duplicate
+        population) that skips per-entity overlay churn.  Duplicate
         entity_ids keep the last occurrence (WAL replay order)."""
-        with self._lock:
-            by_id = {r.entity_id: r for r in records}
-            self.records = dict(enumerate(by_id.values()))
+        with self._write_lock:
+            self.records = {r.entity_id: r for r in records}
             self._rebuild_locked()
 
-    # -- read path -----------------------------------------------------------
-
-    def _pad_keys(self, keys: np.ndarray) -> np.ndarray:
-        keys = np.unique(np.asarray(keys, dtype=np.int32))
-        q = _bucket(max(len(keys), 1))
-        out = np.full(q, -1, np.int32)
-        out[: len(keys)] = keys
-        return out
+    # -- read path (lock-free) -----------------------------------------------
 
     def query(
         self,
@@ -299,98 +270,21 @@ class DarTable:
         owner_id: Optional[int] = None,
     ) -> List[str]:
         """Entity ids intersecting the query volume (live at/after now)."""
-        with self._lock:
-            if len(np.asarray(keys).ravel()) == 0:
-                return []
-            padded = self._pad_keys(keys)[None, :]
-            spec = QuerySpec(
-                keys=jnp.asarray(padded),
-                alt_lo=jnp.asarray(
-                    [np.float32(-np.inf) if alt_lo is None else np.float32(alt_lo)]
-                ),
-                alt_hi=jnp.asarray(
-                    [np.float32(np.inf) if alt_hi is None else np.float32(alt_hi)]
-                ),
-                t_start=jnp.asarray(
-                    [NO_TIME_LO if t_start is None else np.int64(t_start)]
-                ),
-                t_end=jnp.asarray(
-                    [NO_TIME_HI if t_end is None else np.int64(t_end)]
-                ),
-            )
-            owner_arr = (
-                jnp.asarray([np.int32(owner_id)]) if owner_id is not None else None
-            )
-            slots, overflow = conflict_query_batch(
-                self._base,
-                self._delta,
-                self._ents,
-                spec,
-                jnp.int64(now),
-                owner_arr,
-                base_cap=self.base_cap,
-                delta_cap=_DELTA_PER_KEY_CAP,
-                max_results=self.max_results,
-                with_owner=owner_id is not None,
-            )
-            if bool(overflow[0]):
-                # exact fallback on the host
-                slot_list = oracle.search(
-                    self.records,
-                    np.asarray(keys),
-                    alt_lo,
-                    alt_hi,
-                    t_start,
-                    t_end,
-                    now,
-                    owner_id,
-                )
-            else:
-                arr = np.asarray(slots[0])
-                slot_list = [int(s) for s in arr[arr != INT32_MAX]]
-            out = []
-            for s in slot_list:
-                rec = self.records.get(s)
-                if rec is not None:
-                    out.append(rec.entity_id)
-            return out
-
-    def _ensure_fast_locked(self):
-        """Build (or reuse) the batch fast path from the current base.
-        Folds any pending delta with a rebuild first.  Returns
-        (FastTable, snapshot dict) where the snapshot carries immutable
-        per-slot arrays + the slot->entity_id list, so queries can
-        assemble results without holding the lock (a concurrent upsert
-        mutates self.records in place)."""
-        if self._fast is None or self._delta_count:
-            self._rebuild_locked()
-            cols = self._host_cols
-            n = cols.n_postings
-            pe = self._base_ent[:n]
-            ids = [None] * (cols.capacity + 1)
-            for slot, rec in self.records.items():
-                ids[slot] = rec.entity_id
-            ft = FastTable(
-                self._base_key[:n],
-                pe,
-                cols.alt_lo[pe],
-                cols.alt_hi[pe],
-                cols.t_start[pe],
-                cols.t_end[pe],
-                cols.active[pe],
-                slot_exact={
-                    "alt_lo": cols.alt_lo,
-                    "alt_hi": cols.alt_hi,
-                    "t0": cols.t_start,
-                    "t1": cols.t_end,
-                    "live": cols.active.copy(),
-                },
-            )
-            # owner + ids are the only per-slot columns the read path
-            # still needs host-side; exact filtering happens on device
-            # (FastTable.slot_exact carries the fallback copies)
-            self._fast = (ft, {"owner": cols.owner, "ids": ids})
-        return self._fast
+        if len(np.asarray(keys).ravel()) == 0:
+            return []
+        return self.query_many(
+            [np.asarray(keys, np.int32).ravel()],
+            np.asarray([-np.inf if alt_lo is None else alt_lo], np.float32),
+            np.asarray([np.inf if alt_hi is None else alt_hi], np.float32),
+            np.asarray(
+                [NO_TIME_LO if t_start is None else t_start], np.int64
+            ),
+            np.asarray([NO_TIME_HI if t_end is None else t_end], np.int64),
+            now=now,
+            owner_ids=None
+            if owner_id is None
+            else np.asarray([owner_id], np.int32),
+        )[0]
 
     def query_many(
         self,
@@ -400,73 +294,83 @@ class DarTable:
         t_start: np.ndarray,  # i64[B] ns, NO_TIME_LO unbounded
         t_end: np.ndarray,
         *,
-        now: int,
+        now,  # int scalar or i64[B] per-query
         owner_ids: Optional[np.ndarray] = None,  # i32[B], -1 = no filter
     ) -> List[List[str]]:
-        """Batched search via the fast path (host range lookup + dense
-        device filter + exact host re-check).  Exact same result sets
-        as query(); built for high-QPS read service and the bench."""
-        with self._lock:
-            ft, snap = self._ensure_fast_locked()
+        """Batched search via the fused fast path + overlay scan.
+        Lock-free: runs against ONE atomically-grabbed immutable state."""
+        st = self._state
         b = len(keys_list)
         if b == 0:
             return []
+        out_sets = [set() for _ in range(b)]
+        now_arr = np.broadcast_to(np.asarray(now, np.int64), (b,))
         width = max(16, pow2_at_least(max(len(k) for k in keys_list), lo=16))
         qkeys = np.full((b, width), -1, np.int32)
         for i, k in enumerate(keys_list):
             u = np.unique(np.asarray(k, np.int32))
             qkeys[i, : len(u)] = u
-        qidx, slots = ft.query_fused(
-            qkeys, alt_lo, alt_hi, t_start, t_end, now=now
-        )
-        if owner_ids is not None:
-            keep = (owner_ids[qidx] < 0) | (
-                snap["owner"][slots] == owner_ids[qidx]
+
+        if st.snap.fast is not None:
+            qidx, slots = st.snap.fast.query_fused(
+                qkeys, alt_lo, alt_hi, t_start, t_end, now=now_arr
             )
-            qidx, slots = qidx[keep], slots[keep]
-        # dedup (an entity can hit via several cells) and assemble ids
-        pairs = np.unique(qidx * np.int64(2**32) + slots)
-        ids = snap["ids"]
-        out = [[] for _ in range(b)]
-        for p in pairs:
-            i, s = int(p >> 32), int(p & 0xFFFFFFFF)
-            eid = ids[s] if s < len(ids) else None
-            if eid is not None:
-                out[i].append(eid)
-        return out
+            if len(qidx):
+                if st.dead:
+                    keep = ~np.isin(
+                        slots, np.fromiter(st.dead, np.int64, len(st.dead))
+                    )
+                    qidx, slots = qidx[keep], slots[keep]
+                if owner_ids is not None and len(qidx):
+                    keep = (owner_ids[qidx] < 0) | (
+                        st.snap.owner[slots] == owner_ids[qidx]
+                    )
+                    qidx, slots = qidx[keep], slots[keep]
+            ids = st.snap.ids
+            for p in np.unique(qidx * np.int64(2**32) + slots):
+                i, s = int(p >> 32), int(p & 0xFFFFFFFF)
+                if s < len(ids):
+                    out_sets[i].add(ids[s])
+
+        if st.overlay is not None:
+            oq, oent = _overlay_search(
+                st.overlay, qkeys, alt_lo, alt_hi, t_start, t_end,
+                now_arr, owner_ids,
+            )
+            oids = st.overlay.ids
+            for p in np.unique(oq * np.int64(2**32) + oent):
+                i, s = int(p >> 32), int(p & 0xFFFFFFFF)
+                out_sets[i].add(oids[s])
+
+        # an entity updated since the snapshot build appears via the
+        # overlay only (its old slot is in st.dead); sets dedup any
+        # transient double-sighting.  Sorted for deterministic responses.
+        return [sorted(s) for s in out_sets]
 
     def max_owner_count(self, keys: np.ndarray, owner_id: int, *, now: int) -> int:
         """DSS0030 quota metric: max per-cell count of live entities owned
-        by owner_id over the query cells."""
-        with self._lock:
-            if len(np.asarray(keys).ravel()) == 0:
-                return 0
-            padded = self._pad_keys(keys)
-            val = _kernel_max_count(
-                self._base,
-                self._delta,
-                self._ents,
-                jnp.asarray(padded),
-                jnp.int64(now),
-                jnp.int32(owner_id),
-                base_cap=self.base_cap,
-                delta_cap=_DELTA_PER_KEY_CAP,
-            )
-            return int(val)
+        by owner_id over the query cells
+        (pkg/rid/cockroach/subscriptions.go:86-116)."""
+        qk = np.unique(np.asarray(keys, np.int32).ravel())
+        if len(qk) == 0:
+            return 0
+        ids = self.query(qk, now=now, owner_id=owner_id)
+        counts = {int(k): 0 for k in qk}
+        for eid in ids:
+            rec = self.records.get(eid)
+            if rec is None:
+                continue
+            for k in np.intersect1d(rec.keys, qk):
+                counts[int(k)] += 1
+        return max(counts.values(), default=0)
 
-    # -- introspection (bench / graft entry) ----------------------------------
-
-    @property
-    def device_state(self):
-        with self._lock:
-            return self._base, self._delta, self._ents
+    # -- introspection --------------------------------------------------------
 
     def stats(self) -> dict:
-        with self._lock:
-            return {
-                "live_records": len(self.records),
-                "entity_capacity": self._entity_capacity,
-                "base_postings": int((self._base_key != INT32_MAX).sum()),
-                "delta_postings": self._delta_count,
-                "base_cap": self.base_cap,
-            }
+        st = self._state
+        return {
+            "live_records": len(self.records),
+            "snapshot_records": len(st.snap.ids),
+            "pending_records": len(st.pending),
+            "dead_slots": len(st.dead),
+        }

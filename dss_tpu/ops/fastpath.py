@@ -260,8 +260,10 @@ class FastTable:
         b_alo, b_ahi, b_t0, b_t1,  # (NB, 128) exact block columns
         wins,  # (2, NWpad) i32: [block index, start | end<<8 | qidx<<16]
         q_alo, q_ahi,  # exact per-query f32[B]
-        q_t0, q_t1,  # exact per-query i64[B]
-        now,  # i64 scalar
+        q_t0, q_t1,  # exact per-query i64[B]; q_t0 pre-folded with now
+        #              host-side: t0_eff = max(t_start, now), so
+        #              `t_end >= t0_eff` covers both the window test and
+        #              the `ends at/after now` liveness rule, per query
         *, max_words, chunk=16384,
     ):
         """Exact window filter + hit bit-packing + word compaction, all
@@ -291,7 +293,7 @@ class FastTable:
                 & (lanes[None, :] < end[:, None])
                 & (jnp.take(b_ahi, blk, axis=0) >= alo_c[:, None])
                 & (jnp.take(b_alo, blk, axis=0) <= ahi_c[:, None])
-                & (jnp.take(b_t1, blk, axis=0) >= jnp.maximum(t0_c, now)[:, None])
+                & (jnp.take(b_t1, blk, axis=0) >= t0_c[:, None])
                 & (jnp.take(b_t0, blk, axis=0) <= t1_c[:, None])
             )  # (C, 128) bool, exact
             # bit-pack 128 lanes -> 4 u32 words (exact, incl. bit 31:
@@ -403,7 +405,7 @@ class FastTable:
         t_start: np.ndarray,  # i64[B] ns (NO_TIME_LO if unbounded)
         t_end: np.ndarray,
         *,
-        now: int,
+        now,  # int scalar or i64[B] per-query request time
         max_words: int = 1 << 16,
     ) -> Optional[PendingBatch]:
         """Enqueue one fused query batch (async; no device sync).
@@ -414,6 +416,11 @@ class FastTable:
         if nw == 0:
             return None
 
+        # fold the liveness rule into the lower time bound per query:
+        # t_end >= max(t_start, now) == (t_end >= t_start) & (t_end >= now)
+        t0_eff = np.maximum(
+            np.asarray(t_start, np.int64), np.asarray(now, np.int64)
+        )
         out = self._fused_xla(
             self.b_alo,
             self.b_ahi,
@@ -422,9 +429,8 @@ class FastTable:
             jnp.asarray(wins),
             jnp.asarray(np.asarray(alt_lo, np.float32)),
             jnp.asarray(np.asarray(alt_hi, np.float32)),
-            jnp.asarray(np.asarray(t_start, np.int64)),
+            jnp.asarray(np.broadcast_to(t0_eff, (len(qkeys),))),
             jnp.asarray(np.asarray(t_end, np.int64)),
-            jnp.int64(now),
             max_words=max_words,
         )
         try:
@@ -586,7 +592,7 @@ class FastTable:
         t_start: np.ndarray,
         t_end: np.ndarray,
         *,
-        now: int,
+        now,  # int scalar or i64[B] per-query request time
     ) -> Tuple[np.ndarray, np.ndarray]:
         """Drop quantization false positives; -> (qidx, slots).
 
@@ -594,12 +600,15 @@ class FastTable:
         compare is `win_key == qk`), so only the quantized attribute
         tests need re-checking here."""
         slots = self.host_ent[offs]
+        now_q = np.asarray(now, np.int64)
+        if now_q.ndim:
+            now_q = now_q[qidx]
         keep = (
             records_live[slots]
             & (records_alt_hi[slots] >= alt_lo[qidx])
             & (records_alt_lo[slots] <= alt_hi[qidx])
             & (records_t1[slots] >= t_start[qidx])
             & (records_t0[slots] <= t_end[qidx])
-            & (records_t1[slots] >= now)
+            & (records_t1[slots] >= now_q)
         )
         return qidx[keep], slots[keep]

@@ -1,0 +1,203 @@
+"""Serving-stack concurrency: lock-free snapshot reads, the query
+coalescer, and concurrent HTTP searches against a live socket.
+
+The reference gets read concurrency from CRDB MVCC (goroutine-per-RPC
+against SQL, pkg/rid/cockroach); here reads run lock-free against the
+published DarTable snapshot + pending overlay, and concurrent requests
+are micro-batched into single fused kernel launches
+(dss_tpu/dar/coalesce.py)."""
+
+import threading
+import time
+import uuid
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from dss_tpu.dar.coalesce import QueryCoalescer
+from dss_tpu.dar.snapshot import DarTable
+
+NOW = 1_700_000_000_000_000_000
+HOUR = 3_600_000_000_000
+
+
+def _fill(table, n, key_space, rng, prefix="e"):
+    for i in range(n):
+        nk = int(rng.integers(1, 6))
+        keys = np.unique(rng.integers(0, key_space, nk).astype(np.int32))
+        alo, ahi = sorted(rng.uniform(0, 3000, 2))
+        t0 = NOW - HOUR
+        table.upsert(f"{prefix}{i}", keys, float(alo), float(ahi), t0, NOW + HOUR, i % 5)
+
+
+def test_coalescer_concurrent_matches_serial():
+    rng = np.random.default_rng(7)
+    table = DarTable(delta_capacity=256)
+    _fill(table, 300, 80, rng)
+    co = QueryCoalescer(table)
+    queries = []
+    for _ in range(64):
+        nq = int(rng.integers(1, 8))
+        keys = np.unique(rng.integers(0, 80, nq).astype(np.int32))
+        queries.append(keys)
+
+    serial = [table.query(k, now=NOW) for k in queries]
+    with ThreadPoolExecutor(max_workers=16) as pool:
+        concurrent = list(pool.map(lambda k: co.query(k, now=NOW), queries))
+    co.close()
+    for s, c in zip(serial, concurrent):
+        assert sorted(s) == sorted(c)
+
+
+def test_coalescer_mixed_bounds_and_owners():
+    rng = np.random.default_rng(8)
+    table = DarTable(delta_capacity=128)
+    _fill(table, 200, 40, rng)
+    co = QueryCoalescer(table)
+
+    cases = []
+    for i in range(40):
+        keys = np.unique(rng.integers(0, 40, 3).astype(np.int32))
+        alt_lo = None if i % 3 == 0 else float(rng.uniform(0, 2000))
+        alt_hi = None if alt_lo is None else alt_lo + 500.0
+        t0 = None if i % 4 == 0 else NOW - 2 * HOUR
+        t1 = None if t0 is None else NOW + 2 * HOUR
+        owner = None if i % 2 == 0 else int(rng.integers(0, 5))
+        # per-query now values differ (coalesced batches mix them)
+        now = NOW + int(rng.integers(0, 10)) * 1000
+        cases.append((keys, alt_lo, alt_hi, t0, t1, now, owner))
+
+    def run_direct(c):
+        keys, alt_lo, alt_hi, t0, t1, now, owner = c
+        return table.query(
+            keys, alt_lo, alt_hi, t0, t1, now=now, owner_id=owner
+        )
+
+    def run_coalesced(c):
+        keys, alt_lo, alt_hi, t0, t1, now, owner = c
+        return co.query(keys, alt_lo, alt_hi, t0, t1, now=now, owner_id=owner)
+
+    serial = [run_direct(c) for c in cases]
+    with ThreadPoolExecutor(max_workers=12) as pool:
+        concurrent = list(pool.map(run_coalesced, cases))
+    co.close()
+    for s, c in zip(serial, concurrent):
+        assert sorted(s) == sorted(c)
+
+
+def test_reads_never_lose_stable_entities_during_writes():
+    """Entities written before the readers start and never modified must
+    appear in every concurrent read, regardless of writer churn that
+    forces snapshot rebuilds underneath."""
+    rng = np.random.default_rng(9)
+    table = DarTable(delta_capacity=64)  # rebuild often
+    stable_key = np.asarray([999], np.int32)
+    for i in range(5):
+        table.upsert(f"stable{i}", stable_key, None, None, NOW - HOUR, NOW + HOUR, 0)
+    stop = threading.Event()
+    errors = []
+
+    def writer():
+        i = 0
+        while not stop.is_set():
+            keys = np.unique(rng.integers(0, 50, 3).astype(np.int32))
+            table.upsert(f"churn{i % 40}", keys, None, None, NOW - HOUR, NOW + HOUR, 1)
+            if i % 7 == 0:
+                table.remove(f"churn{(i - 3) % 40}")
+            i += 1
+
+    def reader():
+        want = {f"stable{i}" for i in range(5)}
+        while not stop.is_set():
+            try:
+                got = set(table.query(stable_key, now=NOW))
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+                return
+            if not want.issubset(got):
+                errors.append(AssertionError(f"lost entities: {want - got}"))
+                return
+
+    threads = [threading.Thread(target=writer)] + [
+        threading.Thread(target=reader) for _ in range(4)
+    ]
+    for t in threads:
+        t.start()
+    time.sleep(1.5)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    assert not errors, errors[0]
+
+
+@pytest.mark.usefixtures("keypair")
+def test_http_concurrent_searches(keypair):
+    """Live-socket: concurrent ISA searches against a seeded store all
+    succeed and return the full result set (the micro-batched HTTP read
+    path, VERDICT round-1 item 3)."""
+    from tests.test_http_api import (
+        AUD,
+        Client,
+        LiveServer,
+        hdr,
+        isa_params,
+    )
+    from dss_tpu.api.app import RID_SCOPES, SCD_SCOPES, build_app
+    from dss_tpu.auth.authorizer import Authorizer, StaticKeyResolver
+    from dss_tpu.clock import Clock
+    from dss_tpu.dar.dss_store import DSSStore
+    from dss_tpu.services.rid import RIDService
+    from dss_tpu.services.scd import SCDService
+
+    priv, pub = keypair
+    clock = Clock()
+    store = DSSStore(storage="tpu", clock=clock)
+    scopes = dict(RID_SCOPES)
+    scopes.update(SCD_SCOPES)
+    authorizer = Authorizer(
+        StaticKeyResolver([pub]), audiences=[AUD], scopes_table=scopes
+    )
+    app = build_app(
+        RIDService(store.rid, clock),
+        SCDService(store.scd, clock),
+        authorizer,
+    )
+    srv = LiveServer(app)
+    try:
+        client = Client(srv.base)
+        n_isas = 12
+        ids = [str(uuid.uuid4()) for _ in range(n_isas)]
+        for isa_id in ids:
+            r = client.put(
+                f"/v1/dss/identification_service_areas/{isa_id}",
+                json=isa_params(),
+                headers=hdr(keypair),
+            )
+            assert r.status_code == 200, r.text
+        area = "40.0,-100.0,40.02,-100.0,40.02,-99.98,40.0,-99.98"
+
+        def search(_):
+            r = client.get(
+                "/v1/dss/identification_service_areas",
+                params={"area": area},
+                headers=hdr(keypair),
+            )
+            assert r.status_code == 200, r.text
+            got = {
+                isa["id"]
+                for isa in r.json()["service_areas"]
+            }
+            assert set(ids).issubset(got)
+            return True
+
+        t0 = time.perf_counter()
+        n_requests = 48
+        with ThreadPoolExecutor(max_workers=12) as pool:
+            results = list(pool.map(search, range(n_requests)))
+        dt = time.perf_counter() - t0
+        assert all(results)
+        # soft signal in test output, not a hard perf assert (CI is CPU)
+        print(f"concurrent HTTP search: {n_requests / dt:.1f} req/s")
+    finally:
+        srv.stop()
