@@ -81,10 +81,18 @@ class RIDStoreImpl(RIDStore):
         self._owners = owners
         self._lock = lock
         self._journal = journal
+        self._index_factory = index_factory
         self._isas: Dict[str, ridm.IdentificationServiceArea] = {}
         self._subs: Dict[str, ridm.Subscription] = {}
         self._isa_index = index_factory()
         self._sub_index = index_factory()
+
+    def reset_state(self):
+        """Drop all local state (region resync rebuilds from the log)."""
+        self._isas = {}
+        self._subs = {}
+        self._isa_index = self._index_factory()
+        self._sub_index = self._index_factory()
 
     @contextlib.contextmanager
     def transaction(self):
@@ -304,10 +312,18 @@ class SCDStoreImpl(SCDStore):
         self._owners = owners
         self._lock = lock
         self._journal = journal
+        self._index_factory = index_factory
         self._ops: Dict[str, scdm.Operation] = {}
         self._subs: Dict[str, scdm.Subscription] = {}
         self._op_index = index_factory()
         self._sub_index = index_factory()
+
+    def reset_state(self):
+        """Drop all local state (region resync rebuilds from the log)."""
+        self._ops = {}
+        self._subs = {}
+        self._op_index = self._index_factory()
+        self._sub_index = self._index_factory()
 
     @contextlib.contextmanager
     def transaction(self):

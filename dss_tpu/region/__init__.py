@@ -1,0 +1,36 @@
+"""Multi-instance DSS Region: replicated write log + per-instance DAR.
+
+The reference's region story is a shared CockroachDB cluster — N
+organizations' DSS instances gossip/Raft-replicate one SQL database
+(README.md:22-49, implementation_details.md:11-42) and every instance
+reads its own replica.  The TPU-native translation keeps the same
+shape with the roles re-cast:
+
+  - the REGION LOG (dss_tpu.region.log_server) is the shared, ordered,
+    durable source of truth — the CRDB-analog running over DCN;
+  - every DSS instance's HBM DAR is a READ REPLICA built by replaying
+    the log (dss_tpu.dar), exactly like the reference's "snapshot is a
+    cache of the database" posture (SURVEY.md §5);
+  - region-wide write serializability comes from a TTL write lease:
+    a writer acquires the lease, catches up to the log head, validates
+    against region-current state (version fences, OVN checks, quota),
+    appends its logical operation as ONE atomic batch, and releases.
+    This trades CRDB's optimistic MVCC for a simple total order —
+    correct first; the DSS workload is read-heavy.
+
+Consistency properties:
+  - writes: region-serializable (single lease + catch-up before
+    validation); a logical operation's records land atomically.
+  - reads on the writing instance: read-your-writes (the writer
+    applies locally before acknowledging).
+  - reads on other instances: bounded staleness = tail-poll interval
+    (default 50 ms) + transfer; monotonic (records apply in log order).
+  - crash recovery: an instance that fails an append (lease fenced) or
+    restarts resynchronizes by replaying the full log from the region
+    server, which owns durability via its write-ahead file.
+"""
+
+from dss_tpu.region.client import RegionClient, RegionError
+from dss_tpu.region.log_server import build_region_app
+
+__all__ = ["RegionClient", "RegionError", "build_region_app"]

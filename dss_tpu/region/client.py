@@ -1,0 +1,96 @@
+"""Synchronous client for the region log server (DSS-instance side)."""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import List, Optional, Tuple
+
+import requests
+
+
+class RegionError(RuntimeError):
+    """Region log unreachable, lease unavailable, or append fenced."""
+
+
+class RegionClient:
+    def __init__(
+        self,
+        base_url: str,
+        instance_id: Optional[str] = None,
+        *,
+        lease_ttl_s: float = 10.0,
+        acquire_timeout_s: float = 10.0,
+        http_timeout_s: float = 5.0,
+    ):
+        self.base = base_url.rstrip("/")
+        self.instance_id = instance_id or f"dss-{uuid.uuid4()}"
+        self.lease_ttl_s = lease_ttl_s
+        self.acquire_timeout_s = acquire_timeout_s
+        self._timeout = http_timeout_s
+        self._session = requests.Session()
+
+    def acquire_lease(self) -> int:
+        """Blocking acquire with backoff; -> fencing token."""
+        deadline = time.monotonic() + self.acquire_timeout_s
+        delay = 0.005
+        while True:
+            try:
+                r = self._session.post(
+                    f"{self.base}/lease",
+                    json={
+                        "holder": self.instance_id,
+                        "ttl_s": self.lease_ttl_s,
+                    },
+                    timeout=self._timeout,
+                )
+            except requests.RequestException as e:
+                raise RegionError(f"region log unreachable: {e}") from e
+            if r.status_code == 200:
+                return int(r.json()["token"])
+            if time.monotonic() >= deadline:
+                raise RegionError(
+                    f"region write lease unavailable "
+                    f"(held by {r.json().get('holder')})"
+                )
+            time.sleep(delay)
+            delay = min(delay * 2, 0.25)
+
+    def release_lease(self, token: int) -> None:
+        try:
+            self._session.delete(
+                f"{self.base}/lease",
+                json={"token": token},
+                timeout=self._timeout,
+            )
+        except requests.RequestException:
+            pass  # lease expires on its own TTL
+
+    def append(self, token: int, records: List[dict]) -> int:
+        """-> index of the first appended record.  Raises RegionError if
+        the lease was fenced (caller must resync)."""
+        try:
+            r = self._session.post(
+                f"{self.base}/append",
+                json={"token": token, "records": records},
+                timeout=self._timeout,
+            )
+        except requests.RequestException as e:
+            raise RegionError(f"region append failed: {e}") from e
+        if r.status_code != 200:
+            raise RegionError(f"region append fenced: {r.text}")
+        return int(r.json()["from_index"])
+
+    def fetch(self, from_index: int) -> Tuple[List[Tuple[int, dict]], int]:
+        """-> ([(index, record), ...], head)."""
+        try:
+            r = self._session.get(
+                f"{self.base}/records",
+                params={"from": from_index},
+                timeout=self._timeout,
+            )
+            r.raise_for_status()
+        except requests.RequestException as e:
+            raise RegionError(f"region fetch failed: {e}") from e
+        body = r.json()
+        return [(int(i), rec) for i, rec in body["records"]], int(body["head"])

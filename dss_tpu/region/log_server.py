@@ -1,0 +1,135 @@
+"""The region log server: ordered durable record log + write lease.
+
+The CRDB-cluster stand-in for a DSS Region (README.md:22-49).  One
+asyncio process holds:
+
+  - an append-only record log, persisted through WriteAheadLog so a
+    restarted region recovers its full history;
+  - a single TTL write lease; appends are fenced by the lease token,
+    so a paused/partitioned writer whose lease expired cannot corrupt
+    the order (the fencing-token pattern).
+
+Endpoints (JSON over HTTP — the DCN transport stand-in):
+  POST   /lease    {holder, ttl_s}        -> {token} | 409 {holder}
+  DELETE /lease    {token}                -> {}
+  POST   /append   {token, records}       -> {from_index} | 409
+  GET    /records?from=N&limit=M          -> {records: [[idx, rec]...],
+                                              head: int}
+  GET    /healthy
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+from aiohttp import web
+
+from dss_tpu.dar.wal import WriteAheadLog
+
+MAX_FETCH = 1000
+
+
+class RegionLog:
+    def __init__(self, wal_path: Optional[str] = None):
+        self._wal = WriteAheadLog(wal_path)
+        self._records: List[dict] = [rec for rec in self._wal.replay()]
+        self._lease_holder: Optional[str] = None
+        self._lease_token = 0
+        self._lease_expires = 0.0
+
+    @property
+    def head(self) -> int:
+        return len(self._records)
+
+    def acquire(self, holder: str, ttl_s: float):
+        now = time.monotonic()
+        if self._lease_holder is not None and now < self._lease_expires:
+            if self._lease_holder != holder:
+                return None
+            # re-acquire by the same holder extends the lease
+        self._lease_token += 1
+        self._lease_holder = holder
+        self._lease_expires = now + ttl_s
+        return self._lease_token
+
+    def release(self, token: int) -> bool:
+        if token != self._lease_token:
+            return False
+        self._lease_holder = None
+        self._lease_expires = 0.0
+        return True
+
+    def append(self, token: int, records: List[dict]) -> Optional[int]:
+        if (
+            token != self._lease_token
+            or self._lease_holder is None
+            or time.monotonic() >= self._lease_expires
+        ):
+            return None  # fenced: stale or expired lease
+        start = len(self._records)
+        for rec in records:
+            self._wal.append(rec)
+            self._records.append(rec)
+        return start
+
+    def fetch(self, from_index: int, limit: int = MAX_FETCH):
+        end = min(len(self._records), from_index + limit)
+        return [
+            [i, self._records[i]] for i in range(max(from_index, 0), end)
+        ]
+
+    def close(self):
+        self._wal.close()
+
+
+def build_region_app(wal_path: Optional[str] = None) -> web.Application:
+    log = RegionLog(wal_path)
+    app = web.Application()
+    app["region_log"] = log
+
+    async def healthy(request):
+        return web.Response(text="ok")
+
+    async def lease_acquire(request):
+        body = await request.json()
+        token = log.acquire(
+            str(body.get("holder", "")), float(body.get("ttl_s", 10.0))
+        )
+        if token is None:
+            return web.json_response(
+                {"holder": log._lease_holder}, status=409
+            )
+        return web.json_response({"token": token})
+
+    async def lease_release(request):
+        body = await request.json()
+        log.release(int(body.get("token", -1)))
+        return web.json_response({})
+
+    async def append(request):
+        body = await request.json()
+        idx = log.append(
+            int(body.get("token", -1)), list(body.get("records", []))
+        )
+        if idx is None:
+            return web.json_response({"error": "lease fenced"}, status=409)
+        return web.json_response({"from_index": idx})
+
+    async def records(request):
+        frm = int(request.query.get("from", 0))
+        limit = min(int(request.query.get("limit", MAX_FETCH)), MAX_FETCH)
+        return web.json_response(
+            {"records": log.fetch(frm, limit), "head": log.head}
+        )
+
+    async def on_cleanup(app):
+        log.close()
+
+    app.on_cleanup.append(on_cleanup)
+    app.router.add_get("/healthy", healthy)
+    app.router.add_post("/lease", lease_acquire)
+    app.router.add_delete("/lease", lease_release)
+    app.router.add_post("/append", append)
+    app.router.add_get("/records", records)
+    return app
