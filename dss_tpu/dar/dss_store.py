@@ -46,6 +46,20 @@ MAX_RID_SUBSCRIPTIONS_PER_AREA = 10  # DSS0030
 MAX_SCD_SUBSCRIPTIONS_PER_AREA = 10
 
 
+def _bump_sub(subs: Dict[str, object], sub_id: str):
+    """Copy-on-write notification-index bump: replaces the stored record
+    (lock-free readers may hold a reference to the current object).
+    Returns the bumped record, or None if absent."""
+    sub = subs.get(sub_id)
+    if sub is None:
+        return None
+    bumped = dataclasses.replace(
+        sub, notification_index=sub.notification_index + 1
+    )
+    subs[sub_id] = bumped
+    return bumped
+
+
 class TimestampOracle:
     """Strictly-increasing commit timestamps (microsecond granularity),
     the stand-in for CRDB's transaction_timestamp()."""
@@ -65,13 +79,20 @@ class TimestampOracle:
 
 
 class OwnerInterner:
+    """Thread-safe string->id interner.  Lock-free callers (owner-scoped
+    searches) may intern concurrently, so the check-then-set must be
+    atomic or two owners could share one id (tenant mixing)."""
+
     def __init__(self):
         self._ids: Dict[str, int] = {}
+        self._lock = threading.Lock()
 
     def intern(self, owner: str) -> int:
-        if owner not in self._ids:
-            self._ids[owner] = len(self._ids)
-        return self._ids[owner]
+        existing = self._ids.get(owner)  # fast path, no lock
+        if existing is not None:
+            return existing
+        with self._lock:
+            return self._ids.setdefault(owner, len(self._ids))
 
 
 class RIDStoreImpl(RIDStore):
@@ -266,11 +287,9 @@ class RIDStoreImpl(RIDStore):
             ids = self._sub_index.query_ids(cells, now=self._now_ns())
             out = []
             for i in sorted(ids):
-                sub = self._subs.get(i)
-                if sub is None:
-                    continue
-                sub.notification_index += 1
-                out.append(dataclasses.replace(sub))
+                bumped = _bump_sub(self._subs, i)
+                if bumped is not None:
+                    out.append(dataclasses.replace(bumped))
             if out:
                 self._journal({"t": "rid_sub_bump", "ids": [s.id for s in out]})
             return out
@@ -295,8 +314,7 @@ class RIDStoreImpl(RIDStore):
             self._sub_index.remove(rec["id"])
         elif t == "rid_sub_bump":
             for i in rec["ids"]:
-                if i in self._subs:
-                    self._subs[i].notification_index += 1
+                _bump_sub(self._subs, i)
 
 
 class SCDStoreImpl(SCDStore):
@@ -405,11 +423,9 @@ class SCDStoreImpl(SCDStore):
         ids = self._sub_index.query_ids(cells, now=self._now_ns())
         out = []
         for i in sorted(ids):
-            sub = self._subs.get(i)
-            if sub is None:
-                continue
-            sub.notification_index += 1
-            out.append(dataclasses.replace(sub))
+            bumped = _bump_sub(self._subs, i)
+            if bumped is not None:
+                out.append(dataclasses.replace(bumped))
         if out:
             self._journal({"t": "scd_sub_bump", "ids": [s.id for s in out]})
         return out
@@ -601,8 +617,7 @@ class SCDStoreImpl(SCDStore):
             self._sub_index.remove(rec["id"])
         elif t == "scd_sub_bump":
             for i in rec["ids"]:
-                if i in self._subs:
-                    self._subs[i].notification_index += 1
+                _bump_sub(self._subs, i)
 
 
 class DSSStore:

@@ -52,6 +52,7 @@ class _Snapshot(NamedTuple):
     owner: Optional[np.ndarray]  # i32 per slot
     ids: List[str]  # slot -> entity_id
     slot_of: Dict[str, int]  # entity_id -> slot
+    recs: Dict[str, Record]  # id -> Record at build time (immutable)
 
 
 class _Overlay(NamedTuple):
@@ -75,7 +76,7 @@ class _State(NamedTuple):
     dead: frozenset  # snapshot slots superseded/removed since build
 
 
-_EMPTY_SNAPSHOT = _Snapshot(None, None, [], {})
+_EMPTY_SNAPSHOT = _Snapshot(None, None, [], {}, {})
 _EMPTY_STATE = _State(_EMPTY_SNAPSHOT, {}, None, frozenset())
 
 
@@ -240,6 +241,7 @@ class DarTable:
                 owner=packed.owner,
                 ids=ids,
                 slot_of={eid: i for i, eid in enumerate(ids)},
+                recs={r.entity_id: r for r in live},
             )
         self._state = _State(snap, {}, None, frozenset())
 
@@ -296,10 +298,11 @@ class DarTable:
         *,
         now,  # int scalar or i64[B] per-query
         owner_ids: Optional[np.ndarray] = None,  # i32[B], -1 = no filter
+        state: Optional[_State] = None,  # pre-grabbed state (internal)
     ) -> List[List[str]]:
         """Batched search via the fused fast path + overlay scan.
         Lock-free: runs against ONE atomically-grabbed immutable state."""
-        st = self._state
+        st = state if state is not None else self._state
         b = len(keys_list)
         if b == 0:
             return []
@@ -350,14 +353,29 @@ class DarTable:
     def max_owner_count(self, keys: np.ndarray, owner_id: int, *, now: int) -> int:
         """DSS0030 quota metric: max per-cell count of live entities owned
         by owner_id over the query cells
-        (pkg/rid/cockroach/subscriptions.go:86-116)."""
+        (pkg/rid/cockroach/subscriptions.go:86-116).
+
+        The whole computation runs against ONE grabbed immutable state
+        (query + per-cell counts), so the counts can never disagree with
+        the snapshot the query matched — writer-owned `self.records` is
+        never touched."""
         qk = np.unique(np.asarray(keys, np.int32).ravel())
         if len(qk) == 0:
             return 0
-        ids = self.query(qk, now=now, owner_id=owner_id)
+        st = self._state
+        ids = self.query_many(
+            [qk],
+            np.asarray([-np.inf], np.float32),
+            np.asarray([np.inf], np.float32),
+            np.asarray([NO_TIME_LO], np.int64),
+            np.asarray([NO_TIME_HI], np.int64),
+            now=now,
+            owner_ids=np.asarray([owner_id], np.int32),
+            state=st,
+        )[0]
         counts = {int(k): 0 for k in qk}
         for eid in ids:
-            rec = self.records.get(eid)
+            rec = st.pending.get(eid) or st.snap.recs.get(eid)
             if rec is None:
                 continue
             for k in np.intersect1d(rec.keys, qk):

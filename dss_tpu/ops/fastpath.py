@@ -421,16 +421,30 @@ class FastTable:
         t0_eff = np.maximum(
             np.asarray(t_start, np.int64), np.asarray(now, np.int64)
         )
+        # pad the batch axis to a pow2 bucket too: the coalescer drains
+        # arbitrary batch sizes, and an unpadded (B,) shape would force
+        # a fresh XLA compile per distinct B.  Pad queries are inert —
+        # no window's meta references an index >= B.
+        b = len(qkeys)
+        bucket_b = 16
+        while bucket_b < b:
+            bucket_b *= 2
+        bpad = bucket_b - b
+
+        def qpad(a, dtype):
+            a = np.asarray(a, dtype)
+            return np.concatenate([a, np.zeros(bpad, dtype)]) if bpad else a
+
         out = self._fused_xla(
             self.b_alo,
             self.b_ahi,
             self.b_t0,
             self.b_t1,
             jnp.asarray(wins),
-            jnp.asarray(np.asarray(alt_lo, np.float32)),
-            jnp.asarray(np.asarray(alt_hi, np.float32)),
-            jnp.asarray(np.broadcast_to(t0_eff, (len(qkeys),))),
-            jnp.asarray(np.asarray(t_end, np.int64)),
+            jnp.asarray(qpad(alt_lo, np.float32)),
+            jnp.asarray(qpad(alt_hi, np.float32)),
+            jnp.asarray(qpad(np.broadcast_to(t0_eff, (b,)), np.int64)),
+            jnp.asarray(qpad(t_end, np.int64)),
             max_words=max_words,
         )
         try:

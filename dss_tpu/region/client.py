@@ -19,6 +19,7 @@ class RegionClient:
         base_url: str,
         instance_id: Optional[str] = None,
         *,
+        auth_token: Optional[str] = None,
         lease_ttl_s: float = 10.0,
         acquire_timeout_s: float = 10.0,
         http_timeout_s: float = 5.0,
@@ -29,6 +30,16 @@ class RegionClient:
         self.acquire_timeout_s = acquire_timeout_s
         self._timeout = http_timeout_s
         self._session = requests.Session()
+        if auth_token:
+            self._session.headers["Authorization"] = f"Bearer {auth_token}"
+
+    @staticmethod
+    def _json(r) -> dict:
+        """Parse a response body, tolerating non-JSON error pages."""
+        try:
+            return r.json()
+        except ValueError:
+            return {}
 
     def acquire_lease(self) -> int:
         """Blocking acquire with backoff; -> fencing token."""
@@ -47,11 +58,13 @@ class RegionClient:
             except requests.RequestException as e:
                 raise RegionError(f"region log unreachable: {e}") from e
             if r.status_code == 200:
-                return int(r.json()["token"])
+                return int(self._json(r)["token"])
+            if r.status_code == 401:
+                raise RegionError("region auth rejected (bad token)")
             if time.monotonic() >= deadline:
                 raise RegionError(
                     f"region write lease unavailable "
-                    f"(held by {r.json().get('holder')})"
+                    f"(held by {self._json(r).get('holder')})"
                 )
             time.sleep(delay)
             delay = min(delay * 2, 0.25)
@@ -79,7 +92,7 @@ class RegionClient:
             raise RegionError(f"region append failed: {e}") from e
         if r.status_code != 200:
             raise RegionError(f"region append fenced: {r.text}")
-        return int(r.json()["from_index"])
+        return int(self._json(r)["from_index"])
 
     def fetch(self, from_index: int) -> Tuple[List[Tuple[int, dict]], int]:
         """-> ([(index, record), ...], head)."""
@@ -92,5 +105,5 @@ class RegionClient:
             r.raise_for_status()
         except requests.RequestException as e:
             raise RegionError(f"region fetch failed: {e}") from e
-        body = r.json()
+        body = self._json(r)
         return [(int(i), rec) for i, rec in body["records"]], int(body["head"])

@@ -16,10 +16,18 @@ Endpoints (JSON over HTTP — the DCN transport stand-in):
   GET    /records?from=N&limit=M          -> {records: [[idx, rec]...],
                                               head: int}
   GET    /healthy
+
+Auth: when built with `auth_token`, every endpoint except /healthy
+requires `Authorization: Bearer <token>`.  The reference secures
+inter-node CRDB traffic with node certificates
+(implementation_details.md:13-17); a shared region secret is the
+transport-agnostic analog — without it the log would be an
+unauthenticated write surface into authoritative state.
 """
 
 from __future__ import annotations
 
+import hmac
 import time
 from typing import List, Optional
 
@@ -28,6 +36,7 @@ from aiohttp import web
 from dss_tpu.dar.wal import WriteAheadLog
 
 MAX_FETCH = 1000
+MAX_LEASE_TTL_S = 60.0
 
 
 class RegionLog:
@@ -41,6 +50,15 @@ class RegionLog:
     @property
     def head(self) -> int:
         return len(self._records)
+
+    @property
+    def lease_holder(self) -> Optional[str]:
+        """Current holder if the lease is live, else None."""
+        if self._lease_holder is None:
+            return None
+        if time.monotonic() >= self._lease_expires:
+            return None
+        return self._lease_holder
 
     def acquire(self, holder: str, ttl_s: float):
         now = time.monotonic()
@@ -83,42 +101,76 @@ class RegionLog:
         self._wal.close()
 
 
-def build_region_app(wal_path: Optional[str] = None) -> web.Application:
+def build_region_app(
+    wal_path: Optional[str] = None, *, auth_token: Optional[str] = None
+) -> web.Application:
     log = RegionLog(wal_path)
     app = web.Application()
     app["region_log"] = log
+
+    @web.middleware
+    async def auth_middleware(request, handler):
+        if auth_token and request.path != "/healthy":
+            got = request.headers.get("Authorization", "")
+            if not hmac.compare_digest(got, f"Bearer {auth_token}"):
+                return web.json_response(
+                    {"error": "missing or invalid region token"}, status=401
+                )
+        return await handler(request)
+
+    app.middlewares.append(auth_middleware)
 
     async def healthy(request):
         return web.Response(text="ok")
 
     async def lease_acquire(request):
-        body = await request.json()
-        token = log.acquire(
-            str(body.get("holder", "")), float(body.get("ttl_s", 10.0))
-        )
+        try:
+            body = await request.json()
+            holder = str(body.get("holder", ""))
+            ttl_s = float(body.get("ttl_s", 10.0))
+        except (ValueError, TypeError, AttributeError):
+            return web.json_response({"error": "malformed body"}, status=400)
+        if not (0.0 < ttl_s <= MAX_LEASE_TTL_S):
+            return web.json_response(
+                {"error": f"ttl_s must be in (0, {MAX_LEASE_TTL_S}]"},
+                status=400,
+            )
+        token = log.acquire(holder, ttl_s)
         if token is None:
             return web.json_response(
-                {"holder": log._lease_holder}, status=409
+                {"holder": log.lease_holder}, status=409
             )
         return web.json_response({"token": token})
 
     async def lease_release(request):
-        body = await request.json()
-        log.release(int(body.get("token", -1)))
+        try:
+            body = await request.json()
+            token = int(body.get("token", -1))
+        except (ValueError, TypeError, AttributeError):
+            return web.json_response({"error": "malformed body"}, status=400)
+        log.release(token)
         return web.json_response({})
 
     async def append(request):
-        body = await request.json()
-        idx = log.append(
-            int(body.get("token", -1)), list(body.get("records", []))
-        )
+        try:
+            body = await request.json()
+            token = int(body.get("token", -1))
+            records = list(body.get("records", []))
+        except (ValueError, TypeError, AttributeError):
+            return web.json_response({"error": "malformed body"}, status=400)
+        idx = log.append(token, records)
         if idx is None:
             return web.json_response({"error": "lease fenced"}, status=409)
         return web.json_response({"from_index": idx})
 
     async def records(request):
-        frm = int(request.query.get("from", 0))
-        limit = min(int(request.query.get("limit", MAX_FETCH)), MAX_FETCH)
+        try:
+            frm = int(request.query.get("from", 0))
+            limit = min(int(request.query.get("limit", MAX_FETCH)), MAX_FETCH)
+        except ValueError:
+            return web.json_response(
+                {"error": "malformed from/limit"}, status=400
+            )
         return web.json_response(
             {"records": log.fetch(frm, limit), "head": log.head}
         )
