@@ -1,0 +1,57 @@
+"""The region log server binary: the shared source of truth for a
+multi-instance DSS Region (the CRDB-cluster stand-in, README.md:22-49).
+
+Run one per region; point every DSS instance's --region_url at it:
+
+    python -m dss_tpu.cmds.region_server --addr :8090 \
+        --wal_path /data/region.wal --token_file /secrets/region.token
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+from aiohttp import web
+
+from dss_tpu.region.log_server import build_region_app
+
+
+def make_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="DSS region log server")
+    p.add_argument("--addr", default=":8090", help="address to listen on")
+    p.add_argument(
+        "--wal_path",
+        default="",
+        help="durable log file (the region's source of truth); empty = "
+        "in-memory (testing only)",
+    )
+    p.add_argument(
+        "--token_file",
+        default="",
+        help="file holding the shared region secret; every instance "
+        "must present it as a bearer token (empty = no auth, trusted "
+        "network only).  Env DSS_REGION_TOKEN overrides.",
+    )
+    return p
+
+
+def build(args) -> web.Application:
+    token = os.environ.get("DSS_REGION_TOKEN", "")
+    if not token and args.token_file:
+        with open(args.token_file, "r", encoding="utf-8") as fh:
+            token = fh.read().strip()
+    return build_region_app(
+        args.wal_path or None, auth_token=token or None
+    )
+
+
+def main():
+    args = make_parser().parse_args()
+    app = build(args)
+    host, _, port = args.addr.rpartition(":")
+    web.run_app(app, host=host or "0.0.0.0", port=int(port))
+
+
+if __name__ == "__main__":
+    main()

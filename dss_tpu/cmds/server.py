@@ -12,6 +12,7 @@ Run: python -m dss_tpu.cmds.server --addr :8082 --enable_scd \
 from __future__ import annotations
 
 import argparse
+import os
 
 from aiohttp import web
 
@@ -69,6 +70,30 @@ def make_parser() -> argparse.ArgumentParser:
         action="store_true",
         help="log request bodies (reference --dump_requests)",
     )
+    p.add_argument(
+        "--region_url",
+        default="",
+        help="region log server URL; joins this instance to a "
+        "multi-instance DSS Region (replaces the local WAL)",
+    )
+    p.add_argument(
+        "--region_token_file",
+        default="",
+        help="file holding the shared region secret (env "
+        "DSS_REGION_TOKEN overrides)",
+    )
+    p.add_argument(
+        "--region_poll_interval",
+        type=float,
+        default=0.05,
+        help="seconds between region log tail polls (read staleness "
+        "bound on non-writing instances)",
+    )
+    p.add_argument(
+        "--instance_id",
+        default="",
+        help="stable identity of this DSS instance within the region",
+    )
     return p
 
 
@@ -79,17 +104,26 @@ def build(args) -> web.Application:
     configure_logging()
     log = get_logger("dss.server")
     clock = Clock()
+    region_token = os.environ.get("DSS_REGION_TOKEN", "")
+    if not region_token and args.region_token_file:
+        with open(args.region_token_file, "r", encoding="utf-8") as fh:
+            region_token = fh.read().strip()
     store = DSSStore(
         storage=args.storage,
         clock=clock,
         wal_path=args.wal_path or None,
         wal_fsync=args.wal_fsync,
+        region_url=args.region_url or None,
+        region_token=region_token or None,
+        region_poll_interval_s=args.region_poll_interval,
+        instance_id=args.instance_id or None,
     )
     log.info(
-        "store ready: storage=%s wal=%s scd=%s",
+        "store ready: storage=%s wal=%s scd=%s region=%s",
         args.storage,
         args.wal_path or "(none)",
         args.enable_scd,
+        args.region_url or "(standalone)",
     )
     rid = RIDService(store.rid, clock)
     scd = SCDService(store.scd, clock) if args.enable_scd else None

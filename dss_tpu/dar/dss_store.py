@@ -46,6 +46,17 @@ MAX_RID_SUBSCRIPTIONS_PER_AREA = 10  # DSS0030
 MAX_SCD_SUBSCRIPTIONS_PER_AREA = 10
 
 
+def _lock_txn(lock):
+    """Default transaction factory: just the store lock."""
+
+    @contextlib.contextmanager
+    def txn():
+        with lock:
+            yield
+
+    return txn
+
+
 def _bump_sub(subs: Dict[str, object], sub_id: str):
     """Copy-on-write notification-index bump: replaces the stored record
     (lock-free readers may hold a reference to the current object).
@@ -96,11 +107,14 @@ class OwnerInterner:
 
 
 class RIDStoreImpl(RIDStore):
-    def __init__(self, *, clock, ts_oracle, owners, lock, journal, index_factory):
+    def __init__(
+        self, *, clock, ts_oracle, owners, lock, journal, index_factory, txn=None
+    ):
         self._clock = clock
         self._ts = ts_oracle
         self._owners = owners
         self._lock = lock
+        self._txn = txn if txn is not None else _lock_txn(lock)
         self._journal = journal
         self._index_factory = index_factory
         self._isas: Dict[str, ridm.IdentificationServiceArea] = {}
@@ -117,7 +131,7 @@ class RIDStoreImpl(RIDStore):
 
     @contextlib.contextmanager
     def transaction(self):
-        with self._lock:
+        with self._txn():
             yield self
 
     def _now_ns(self) -> int:
@@ -149,7 +163,7 @@ class RIDStoreImpl(RIDStore):
         )
 
     def insert_isa(self, isa):
-        with self._lock:
+        with self._txn():
             old = self._isas.get(isa.id)
             if isa.version is None or isa.version.empty:
                 if old is not None:
@@ -168,7 +182,7 @@ class RIDStoreImpl(RIDStore):
             return dataclasses.replace(stored)
 
     def delete_isa(self, isa):
-        with self._lock:
+        with self._txn():
             old = self._isas.get(isa.id)
             if (
                 old is None
@@ -220,7 +234,7 @@ class RIDStoreImpl(RIDStore):
         )
 
     def insert_subscription(self, sub):
-        with self._lock:
+        with self._txn():
             old = self._subs.get(sub.id)
             if sub.version is None or sub.version.empty:
                 if old is not None:
@@ -239,7 +253,7 @@ class RIDStoreImpl(RIDStore):
             return dataclasses.replace(stored)
 
     def delete_subscription(self, sub):
-        with self._lock:
+        with self._txn():
             old = self._subs.get(sub.id)
             if (
                 old is None
@@ -283,7 +297,7 @@ class RIDStoreImpl(RIDStore):
         )
 
     def update_notification_idxs_in_cells(self, cells):
-        with self._lock:
+        with self._txn():
             ids = self._sub_index.query_ids(cells, now=self._now_ns())
             out = []
             for i in sorted(ids):
@@ -324,11 +338,14 @@ class SCDStoreImpl(SCDStore):
     def sub_index_stats(self) -> dict:
         return self._sub_index.stats()
 
-    def __init__(self, *, clock, ts_oracle, owners, lock, journal, index_factory):
+    def __init__(
+        self, *, clock, ts_oracle, owners, lock, journal, index_factory, txn=None
+    ):
         self._clock = clock
         self._ts = ts_oracle
         self._owners = owners
         self._lock = lock
+        self._txn = txn if txn is not None else _lock_txn(lock)
         self._journal = journal
         self._index_factory = index_factory
         self._ops: Dict[str, scdm.Operation] = {}
@@ -345,7 +362,7 @@ class SCDStoreImpl(SCDStore):
 
     @contextlib.contextmanager
     def transaction(self):
-        with self._lock:
+        with self._txn():
             yield self
 
     def _now_ns(self) -> int:
@@ -431,7 +448,7 @@ class SCDStoreImpl(SCDStore):
         return out
 
     def upsert_operation(self, op, key):
-        with self._lock:
+        with self._txn():
             old = self._visible_op(op.id)
             if old is None and op.version != 0:
                 raise errors.not_found(op.id)
@@ -471,7 +488,7 @@ class SCDStoreImpl(SCDStore):
             return dataclasses.replace(stored), subs
 
     def delete_operation(self, id, owner):
-        with self._lock:
+        with self._txn():
             old = self._visible_op(id)
             if old is None:
                 raise errors.not_found(id)
@@ -518,7 +535,7 @@ class SCDStoreImpl(SCDStore):
         return out
 
     def upsert_subscription(self, sub):
-        with self._lock:
+        with self._txn():
             old = self._visible_sub(sub.id)
             if old is None and sub.version != 0:
                 raise errors.not_found(sub.id)
@@ -558,7 +575,7 @@ class SCDStoreImpl(SCDStore):
             return dataclasses.replace(stored), affected
 
     def delete_subscription(self, id, owner, version):
-        with self._lock:
+        with self._txn():
             old = self._visible_sub(id)
             if old is None:
                 raise errors.not_found(id)
@@ -621,8 +638,20 @@ class SCDStoreImpl(SCDStore):
 
 
 class DSSStore:
-    """One DSS region's storage: RID + SCD stores sharing a lock, a
-    commit-timestamp oracle, an owner interner, and a WAL."""
+    """One DSS instance's storage: RID + SCD stores sharing a lock, a
+    commit-timestamp oracle, an owner interner, and a durable log.
+
+    Two durability modes:
+      - standalone (default): a local WriteAheadLog is the source of
+        truth; boot replays it.
+      - region (`region_url` set): the shared region log
+        (dss_tpu.region) is the source of truth; every mutation runs
+        as a lease-fenced write-through transaction and a tail poller
+        applies remote instances' writes.  The local WAL is disabled
+        (the region server owns durability), mirroring the reference
+        where instances keep no local state beside the shared CRDB
+        cluster (README.md:22-49).
+    """
 
     def __init__(
         self,
@@ -631,6 +660,10 @@ class DSSStore:
         clock: Optional[Clock] = None,
         wal_path: Optional[str] = None,
         wal_fsync: bool = False,
+        region_url: Optional[str] = None,
+        region_token: Optional[str] = None,
+        region_poll_interval_s: float = 0.05,
+        instance_id: Optional[str] = None,
     ):
         if storage == "tpu":
             index_factory = TpuSpatialIndex
@@ -638,10 +671,25 @@ class DSSStore:
             index_factory = MemorySpatialIndex
         else:
             raise ValueError(f"unknown storage backend {storage!r}")
+        if region_url and wal_path:
+            raise ValueError(
+                "wal_path is unused in region mode: the region log server "
+                "owns durability (give the WAL path to the region server)"
+            )
         self.storage = storage
         self.clock = clock or Clock()
-        self.wal = WriteAheadLog(wal_path, fsync=wal_fsync)
+        self.wal = WriteAheadLog(None if region_url else wal_path, fsync=wal_fsync)
         self._lock = threading.RLock()
+        self.region = None
+        txn = None
+        if region_url:
+            from dss_tpu.region.client import RegionClient
+            from dss_tpu.region.coordinator import RegionCoordinator
+
+            self._region_client = RegionClient(
+                region_url, instance_id, auth_token=region_token
+            )
+            txn = self._region_txn
         ts = TimestampOracle(self.clock)
         owners = OwnerInterner()
         self.rid = RIDStoreImpl(
@@ -651,6 +699,7 @@ class DSSStore:
             lock=self._lock,
             journal=self._journal,
             index_factory=index_factory,
+            txn=txn,
         )
         self.scd = SCDStoreImpl(
             clock=self.clock,
@@ -659,12 +708,30 @@ class DSSStore:
             lock=self._lock,
             journal=self._journal,
             index_factory=index_factory,
+            txn=txn,
         )
         self._replaying = False
-        self._replay()
+        if region_url:
+            self.region = RegionCoordinator(
+                self._region_client,
+                self.rid,
+                self.scd,
+                self._lock,
+                poll_interval_s=region_poll_interval_s,
+            )
+            self.region.bootstrap()
+        else:
+            self._replay()
+
+    def _region_txn(self):
+        return self.region.txn()
 
     def _journal(self, rec: dict):
-        if not self._replaying:
+        if self._replaying:
+            return
+        if self.region is not None:
+            self.region.journal(rec)
+        else:
             self.wal.append(rec)
 
     def _replay(self):
@@ -680,6 +747,8 @@ class DSSStore:
             self._replaying = False
 
     def close(self):
+        if self.region is not None:
+            self.region.close()
         self.wal.close()
 
     def stats(self) -> dict:
@@ -693,4 +762,6 @@ class DSSStore:
         ):
             for k, v in stats().items():
                 out[f"dss_dar_{name}_{k}"] = v
+        if self.region is not None:
+            out.update(self.region.stats())
         return out

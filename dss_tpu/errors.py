@@ -123,6 +123,10 @@ def unauthenticated(msg: str) -> StatusError:
     return StatusError(Code.UNAUTHENTICATED, msg)
 
 
+def unavailable(msg: str) -> StatusError:
+    return StatusError(Code.UNAVAILABLE, msg)
+
+
 def area_too_large(msg: str) -> StatusError:
     return StatusError(Code.AREA_TOO_LARGE, msg)
 

@@ -31,6 +31,12 @@ Consistency properties:
 """
 
 from dss_tpu.region.client import RegionClient, RegionError
+from dss_tpu.region.coordinator import RegionCoordinator
 from dss_tpu.region.log_server import build_region_app
 
-__all__ = ["RegionClient", "RegionError", "build_region_app"]
+__all__ = [
+    "RegionClient",
+    "RegionCoordinator",
+    "RegionError",
+    "build_region_app",
+]

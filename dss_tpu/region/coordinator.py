@@ -1,0 +1,232 @@
+"""Region coordinator: write-through + tail-poll replication for a store.
+
+This is the piece that makes N DSS instances one region (the role CRDB
+replication plays in the reference, README.md:22-49,
+implementation_details.md:11-42).  One coordinator per DSS instance:
+
+  WRITE PATH (region-serializable, lease-fenced):
+    txn() wraps every logical store mutation.  The outermost entry
+      1. acquires the region write lease (fencing token),
+      2. catches up to the log head (applies remote records),
+      3. runs the local validation + mutation (journal records are
+         buffered, not written),
+      4. appends the buffered records to the region log as ONE atomic
+         batch at exactly the local applied index,
+      5. advances the applied index and releases the lease.
+    Validation therefore always runs against region-current state, and
+    the writing instance has read-your-writes (local apply precedes the
+    ack).  Any divergence (fenced append, local apply without a logged
+    batch) triggers a full resync from the log.
+
+  READ PATH (bounded staleness, monotonic):
+    a daemon thread tail-polls the log every `poll_interval_s` and
+    applies new records under the store lock, in log order.  Staleness
+    on a non-writing instance is bounded by poll interval + transfer.
+
+  RECOVERY:
+    boot = full replay of the region log (the log server owns
+    durability via its own WAL); a fenced or failed writer resyncs from
+    scratch the same way, mirroring how the reference treats the DAR
+    snapshot as a cache of the database (SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import threading
+import time
+from typing import List, Optional
+
+from dss_tpu import errors
+from dss_tpu.region.client import RegionClient, RegionError
+
+log = logging.getLogger("dss.region")
+
+
+class RegionCoordinator:
+    def __init__(
+        self,
+        client: RegionClient,
+        rid_store,
+        scd_store,
+        lock: threading.RLock,
+        *,
+        poll_interval_s: float = 0.05,
+    ):
+        self._client = client
+        self._rid = rid_store
+        self._scd = scd_store
+        self._lock = lock
+        self._poll_s = poll_interval_s
+        self._applied = 0  # next log index to apply
+        self._buffer: Optional[List[dict]] = None  # active txn's records
+        self._depth = 0  # txn nesting (guarded by lock)
+        self._dirty = False  # local state diverged; resync required
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- wiring ---------------------------------------------------------------
+
+    @property
+    def collecting(self) -> bool:
+        return self._buffer is not None
+
+    @property
+    def applied(self) -> int:
+        return self._applied
+
+    def journal(self, rec: dict) -> None:
+        """Buffer one journal record for the active txn's batch append.
+        Called by the store's journal hook under the store lock."""
+        if self._buffer is None:
+            raise errors.internal(
+                "region-mode mutation outside a region transaction"
+            )
+        self._buffer.append(rec)
+
+    def bootstrap(self) -> None:
+        """Initial full catch-up from the log, then start tail polling."""
+        with self._lock:
+            self._catch_up_locked()
+        self._thread = threading.Thread(
+            target=self._poll_loop, name="region-tail", daemon=True
+        )
+        self._thread.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+    def stats(self) -> dict:
+        return {
+            "region_applied": self._applied,
+            "region_dirty": int(self._dirty),
+        }
+
+    # -- write-through transaction -------------------------------------------
+
+    @contextlib.contextmanager
+    def txn(self):
+        """Region-serializable transaction (reentrant; the outermost
+        level owns the lease and the batch append)."""
+        with self._lock:
+            if self._depth:
+                self._depth += 1
+                try:
+                    yield
+                finally:
+                    self._depth -= 1
+                return
+
+            if self._dirty:
+                # a previous failure left local state diverged; restore
+                # before accepting writes (reads were already suspect)
+                self._resync_locked()
+
+            try:
+                token = self._client.acquire_lease()
+            except RegionError as e:
+                raise errors.unavailable(f"region write lease: {e}")
+            try:
+                try:
+                    self._catch_up_locked()
+                except RegionError as e:
+                    raise errors.unavailable(f"region catch-up: {e}")
+                self._depth = 1
+                self._buffer = []
+                try:
+                    yield
+                except BaseException:
+                    if self._buffer:
+                        # mutated locally but nothing logged: roll back
+                        # by resyncing from the log
+                        self._resync_or_mark_dirty()
+                    raise
+                finally:
+                    buf, self._buffer = self._buffer, None
+                    self._depth = 0
+                if buf:
+                    self._commit_locked(token, buf)
+            finally:
+                self._client.release_lease(token)
+
+    def _commit_locked(self, token: int, buf: List[dict]) -> None:
+        try:
+            idx = self._client.append(token, buf)
+        except RegionError as e:
+            self._resync_or_mark_dirty()
+            raise errors.unavailable(
+                f"region append fenced; local state resynced: {e}"
+            )
+        if idx != self._applied:
+            # someone slipped between our catch-up and append — the
+            # lease should make this impossible, so treat as fencing
+            self._resync_or_mark_dirty()
+            raise errors.unavailable(
+                f"region log order broke (appended at {idx}, expected "
+                f"{self._applied}); local state resynced"
+            )
+        self._applied += len(buf)
+
+    # -- apply / resync (store lock held) ------------------------------------
+
+    def _apply_locked(self, rec: dict) -> None:
+        t = rec.get("t", "")
+        if t.startswith("isa") or t.startswith("rid"):
+            self._rid.apply_wal(rec)
+        else:
+            self._scd.apply_wal(rec)
+
+    def _catch_up_locked(self) -> None:
+        while True:
+            recs, head = self._client.fetch(self._applied)
+            for idx, rec in recs:
+                if idx >= self._applied:
+                    self._apply_locked(rec)
+                    self._applied = idx + 1
+            if self._applied >= head:
+                return
+
+    def _resync_locked(self) -> None:
+        log.warning("region resync: dropping local state, replaying log")
+        self._rid.reset_state()
+        self._scd.reset_state()
+        self._applied = 0
+        self._catch_up_locked()
+        self._dirty = False
+
+    def _resync_or_mark_dirty(self) -> None:
+        try:
+            self._resync_locked()
+        except RegionError as e:
+            # region unreachable: mark diverged; the tail poller keeps
+            # retrying, and writes refuse until clean
+            log.error("region resync failed (%s); marking dirty", e)
+            self._dirty = True
+
+    # -- tail poller ----------------------------------------------------------
+
+    def _poll_loop(self) -> None:
+        while not self._stop.wait(self._poll_s):
+            try:
+                if self._dirty:
+                    with self._lock:
+                        if self._dirty:
+                            self._resync_locked()
+                    continue
+                # fetch over HTTP without the lock; the idx guard under
+                # the lock drops anything applied concurrently
+                recs, _head = self._client.fetch(self._applied)
+                if not recs:
+                    continue
+                with self._lock:
+                    for idx, rec in recs:
+                        if idx >= self._applied:
+                            self._apply_locked(rec)
+                            self._applied = idx + 1
+            except RegionError:
+                continue  # transient; next tick retries
+            except Exception:  # noqa: BLE001 — keep the poller alive
+                log.exception("region tail poller error")
