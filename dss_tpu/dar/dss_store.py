@@ -108,7 +108,8 @@ class OwnerInterner:
 
 class RIDStoreImpl(RIDStore):
     def __init__(
-        self, *, clock, ts_oracle, owners, lock, journal, index_factory, txn=None
+        self, *, clock, ts_oracle, owners, lock, journal, index_factory,
+        txn=None, capture_undo=False,
     ):
         self._clock = clock
         self._ts = ts_oracle
@@ -117,6 +118,10 @@ class RIDStoreImpl(RIDStore):
         self._txn = txn if txn is not None else _lock_txn(lock)
         self._journal = journal
         self._index_factory = index_factory
+        # region mode: each journal record carries an "undo" list (wal
+        # records that revert the mutation) so the coordinator can roll
+        # back an aborted txn precisely instead of resyncing from the log
+        self._capture_undo = capture_undo
         self._isas: Dict[str, ridm.IdentificationServiceArea] = {}
         self._subs: Dict[str, ridm.Subscription] = {}
         self._isa_index = index_factory()
@@ -178,7 +183,14 @@ class RIDStoreImpl(RIDStore):
             )
             self._isas[stored.id] = stored
             self._index_isa(stored)
-            self._journal({"t": "isa_put", "doc": codec.isa_to_doc(stored)})
+            rec = {"t": "isa_put", "doc": codec.isa_to_doc(stored)}
+            if self._capture_undo:
+                rec["undo"] = [
+                    {"t": "isa_put", "doc": codec.isa_to_doc(old)}
+                    if old is not None
+                    else {"t": "isa_del", "id": stored.id}
+                ]
+            self._journal(rec)
             return dataclasses.replace(stored)
 
     def delete_isa(self, isa):
@@ -193,7 +205,10 @@ class RIDStoreImpl(RIDStore):
                 return None
             del self._isas[isa.id]
             self._isa_index.remove(isa.id)
-            self._journal({"t": "isa_del", "id": isa.id})
+            rec = {"t": "isa_del", "id": isa.id}
+            if self._capture_undo:
+                rec["undo"] = [{"t": "isa_put", "doc": codec.isa_to_doc(old)}]
+            self._journal(rec)
             return dataclasses.replace(old)
 
     def search_isas(self, cells, earliest, latest):
@@ -249,7 +264,14 @@ class RIDStoreImpl(RIDStore):
             )
             self._subs[stored.id] = stored
             self._index_sub(stored)
-            self._journal({"t": "rid_sub_put", "doc": codec.rid_sub_to_doc(stored)})
+            rec = {"t": "rid_sub_put", "doc": codec.rid_sub_to_doc(stored)}
+            if self._capture_undo:
+                rec["undo"] = [
+                    {"t": "rid_sub_put", "doc": codec.rid_sub_to_doc(old)}
+                    if old is not None
+                    else {"t": "rid_sub_del", "id": stored.id}
+                ]
+            self._journal(rec)
             return dataclasses.replace(stored)
 
     def delete_subscription(self, sub):
@@ -264,7 +286,12 @@ class RIDStoreImpl(RIDStore):
                 return None
             del self._subs[sub.id]
             self._sub_index.remove(sub.id)
-            self._journal({"t": "rid_sub_del", "id": sub.id})
+            rec = {"t": "rid_sub_del", "id": sub.id}
+            if self._capture_undo:
+                rec["undo"] = [
+                    {"t": "rid_sub_put", "doc": codec.rid_sub_to_doc(old)}
+                ]
+            self._journal(rec)
             return dataclasses.replace(old)
 
     def search_subscriptions(self, cells):
@@ -300,12 +327,22 @@ class RIDStoreImpl(RIDStore):
         with self._txn():
             ids = self._sub_index.query_ids(cells, now=self._now_ns())
             out = []
+            undo = []
             for i in sorted(ids):
+                if self._capture_undo:
+                    prev = self._subs.get(i)
+                    if prev is not None:
+                        undo.append(
+                            {"t": "rid_sub_put", "doc": codec.rid_sub_to_doc(prev)}
+                        )
                 bumped = _bump_sub(self._subs, i)
                 if bumped is not None:
                     out.append(dataclasses.replace(bumped))
             if out:
-                self._journal({"t": "rid_sub_bump", "ids": [s.id for s in out]})
+                rec = {"t": "rid_sub_bump", "ids": [s.id for s in out]}
+                if self._capture_undo:
+                    rec["undo"] = undo
+                self._journal(rec)
             return out
 
     # -- WAL replay ----------------------------------------------------------
@@ -339,7 +376,8 @@ class SCDStoreImpl(SCDStore):
         return self._sub_index.stats()
 
     def __init__(
-        self, *, clock, ts_oracle, owners, lock, journal, index_factory, txn=None
+        self, *, clock, ts_oracle, owners, lock, journal, index_factory,
+        txn=None, capture_undo=False,
     ):
         self._clock = clock
         self._ts = ts_oracle
@@ -348,6 +386,7 @@ class SCDStoreImpl(SCDStore):
         self._txn = txn if txn is not None else _lock_txn(lock)
         self._journal = journal
         self._index_factory = index_factory
+        self._capture_undo = capture_undo
         self._ops: Dict[str, scdm.Operation] = {}
         self._subs: Dict[str, scdm.Subscription] = {}
         self._op_index = index_factory()
@@ -439,42 +478,68 @@ class SCDStoreImpl(SCDStore):
         (subscriptions.go:128-173)."""
         ids = self._sub_index.query_ids(cells, now=self._now_ns())
         out = []
+        undo = []
         for i in sorted(ids):
+            if self._capture_undo:
+                prev = self._subs.get(i)
+                if prev is not None:
+                    undo.append(
+                        {"t": "scd_sub_put", "doc": codec.scd_sub_to_doc(prev)}
+                    )
             bumped = _bump_sub(self._subs, i)
             if bumped is not None:
                 out.append(dataclasses.replace(bumped))
         if out:
-            self._journal({"t": "scd_sub_bump", "ids": [s.id for s in out]})
+            rec = {"t": "scd_sub_bump", "ids": [s.id for s in out]}
+            if self._capture_undo:
+                rec["undo"] = undo
+            self._journal(rec)
         return out
+
+    def _precheck_op_upsert(self, op, key):
+        """All upsert preconditions (version fencing, ownership, time
+        range, OVN key check — operations.go:305-364), no mutation.
+        Returns the old record (or None)."""
+        old = self._visible_op(op.id)
+        if old is None and op.version != 0:
+            raise errors.not_found(op.id)
+        if old is not None and op.version == 0:
+            raise errors.already_exists(op.id)
+        if old is not None and op.version != old.version:
+            raise errors.version_mismatch("old version")
+        if old is not None and old.owner != op.owner:
+            raise errors.permission_denied(
+                f"Operation is owned by {old.owner}"
+            )
+        op.validate_time_range()
+
+        if op.state in scdm.OperationState.REQUIRES_KEY:
+            conflicting = self._search_ops(
+                op.cells,
+                op.altitude_lower,
+                op.altitude_upper,
+                op.start_time,
+                op.end_time,
+            )
+            key_set = set(key)
+            missing = [c for c in conflicting if c.ovn not in key_set]
+            if missing:
+                raise errors.missing_ovns(missing)
+        return old
+
+    def validate_operation_upsert(self, op, key):
+        """Read-only precheck, run by the service BEFORE any journaled
+        mutation (e.g. the implicit subscription) so a rejected conflict
+        — a routine outcome — aborts the transaction with an empty
+        journal buffer: nothing to roll back, no region resync.
+        upsert_operation re-runs the same checks under the same txn, so
+        the answers agree."""
+        with self._txn():
+            self._precheck_op_upsert(op, key)
 
     def upsert_operation(self, op, key):
         with self._txn():
-            old = self._visible_op(op.id)
-            if old is None and op.version != 0:
-                raise errors.not_found(op.id)
-            if old is not None and op.version == 0:
-                raise errors.already_exists(op.id)
-            if old is not None and op.version != old.version:
-                raise errors.version_mismatch("old version")
-            if old is not None and old.owner != op.owner:
-                raise errors.permission_denied(
-                    f"Operation is owned by {old.owner}"
-                )
-            op.validate_time_range()
-
-            if op.state in scdm.OperationState.REQUIRES_KEY:
-                conflicting = self._search_ops(
-                    op.cells,
-                    op.altitude_lower,
-                    op.altitude_upper,
-                    op.start_time,
-                    op.end_time,
-                )
-                key_set = set(key)
-                missing = [c for c in conflicting if c.ovn not in key_set]
-                if missing:
-                    raise errors.missing_ovns(missing)
-
+            old = self._precheck_op_upsert(op, key)
             ts = self._ts.commit_ts()
             stored = dataclasses.replace(
                 op,
@@ -483,7 +548,14 @@ class SCDStoreImpl(SCDStore):
             )
             self._ops[stored.id] = stored
             self._index_op(stored)
-            self._journal({"t": "scd_op_put", "doc": codec.op_to_doc(stored)})
+            rec = {"t": "scd_op_put", "doc": codec.op_to_doc(stored)}
+            if self._capture_undo:
+                rec["undo"] = [
+                    {"t": "scd_op_put", "doc": codec.op_to_doc(old)}
+                    if old is not None
+                    else {"t": "scd_op_del", "id": stored.id}
+                ]
+            self._journal(rec)
             subs = self._notify_subs_locked(stored.cells)
             return dataclasses.replace(stored), subs
 
@@ -497,7 +569,10 @@ class SCDStoreImpl(SCDStore):
             subs = self._notify_subs_locked(old.cells)
             del self._ops[id]
             self._op_index.remove(id)
-            self._journal({"t": "scd_op_del", "id": id})
+            rec = {"t": "scd_op_del", "id": id}
+            if self._capture_undo:
+                rec["undo"] = [{"t": "scd_op_put", "doc": codec.op_to_doc(old)}]
+            self._journal(rec)
             # implicit-subscription GC (operations.go:249-267,296-298)
             sub = self._subs.get(old.subscription_id)
             if (
@@ -510,7 +585,12 @@ class SCDStoreImpl(SCDStore):
             ):
                 del self._subs[sub.id]
                 self._sub_index.remove(sub.id)
-                self._journal({"t": "scd_sub_del", "id": sub.id})
+                gc_rec = {"t": "scd_sub_del", "id": sub.id}
+                if self._capture_undo:
+                    gc_rec["undo"] = [
+                        {"t": "scd_sub_put", "doc": codec.scd_sub_to_doc(sub)}
+                    ]
+                self._journal(gc_rec)
             return dataclasses.replace(old), subs
 
     # -- Subscriptions -------------------------------------------------------
@@ -560,7 +640,14 @@ class SCDStoreImpl(SCDStore):
             )
             self._subs[stored.id] = stored
             self._index_scd_sub(stored)
-            self._journal({"t": "scd_sub_put", "doc": codec.scd_sub_to_doc(stored)})
+            rec = {"t": "scd_sub_put", "doc": codec.scd_sub_to_doc(stored)}
+            if self._capture_undo:
+                rec["undo"] = [
+                    {"t": "scd_sub_put", "doc": codec.scd_sub_to_doc(old)}
+                    if old is not None
+                    else {"t": "scd_sub_del", "id": stored.id}
+                ]
+            self._journal(rec)
             affected = (
                 self._search_ops(
                     stored.cells,
@@ -589,7 +676,12 @@ class SCDStoreImpl(SCDStore):
                 )
             del self._subs[id]
             self._sub_index.remove(id)
-            self._journal({"t": "scd_sub_del", "id": id})
+            rec = {"t": "scd_sub_del", "id": id}
+            if self._capture_undo:
+                rec["undo"] = [
+                    {"t": "scd_sub_put", "doc": codec.scd_sub_to_doc(old)}
+                ]
+            self._journal(rec)
             return dataclasses.replace(old)
 
     def search_subscriptions(self, cells, owner):

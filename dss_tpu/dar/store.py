@@ -130,6 +130,13 @@ class SCDStore(abc.ABC):
         states; returns (op, subscriptions-to-notify, post-bump)."""
 
     @abc.abstractmethod
+    def validate_operation_upsert(self, op: scdm.Operation, key: List[str]) -> None:
+        """Read-only run of upsert_operation's preconditions (version
+        fencing, ownership, time range, OVN key check).  Must be called
+        inside the same transaction as the upsert so the answers agree;
+        lets the service reject conflicts before journaling anything."""
+
+    @abc.abstractmethod
     def delete_operation(
         self, id: str, owner: str
     ) -> Tuple[scdm.Operation, List[scdm.Subscription]]:

@@ -81,13 +81,48 @@ class SCDService:
         subscription_id = params.get("subscription_id") or ""
         key = [str(k) for k in (params.get("key") or [])]
 
+        op = scdm.Operation(
+            id=entity_uuid,
+            owner=owner,
+            version=ser.int_field(params.get("old_version"), "old_version"),
+            start_time=u_extent.start_time,
+            end_time=u_extent.end_time,
+            altitude_lower=u_extent.spatial_volume.altitude_lo,
+            altitude_upper=u_extent.spatial_volume.altitude_hi,
+            cells=cells,
+            uss_base_url=params["uss_base_url"],
+            subscription_id=subscription_id,
+            state=params.get("state", ""),
+        )
+
+        new_sub = params.get("new_subscription") or {}
+        if not subscription_id:
+            try:
+                validate_uss_base_url(new_sub.get("uss_base_url", ""))
+            except ValueError as e:
+                raise errors.bad_request(str(e))
+
         with self.store.transaction():
+            try:
+                # Validate (incl. the OVN key check) BEFORE journaling
+                # the implicit subscription: a rejected conflict is a
+                # routine outcome and must leave nothing to roll back.
+                self.store.validate_operation_upsert(op, key)
+            except errors.StatusError as e:
+                if e.code == errors.Code.MISSING_OVNS:
+                    # attach the AirspaceConflictResponse payload with
+                    # the full conflict set (operations_handler.go:268-280)
+                    ops = self.store.search_operations(
+                        cells,
+                        u_extent.spatial_volume.altitude_lo,
+                        u_extent.spatial_volume.altitude_hi,
+                        u_extent.start_time,
+                        u_extent.end_time,
+                    )
+                    e.details = _missing_ovns_response(ops)
+                raise
+
             if not subscription_id:
-                new_sub = params.get("new_subscription") or {}
-                try:
-                    validate_uss_base_url(new_sub.get("uss_base_url", ""))
-                except ValueError as e:
-                    raise errors.bad_request(str(e))
                 sub, _ = self.store.upsert_subscription(
                     scdm.Subscription(
                         id=str(uuidlib.uuid4()),
@@ -105,27 +140,12 @@ class SCDService:
                         implicit_subscription=True,
                     )
                 )
-                subscription_id = sub.id
+                op.subscription_id = sub.id
 
-            op = scdm.Operation(
-                id=entity_uuid,
-                owner=owner,
-                version=ser.int_field(params.get("old_version"), "old_version"),
-                start_time=u_extent.start_time,
-                end_time=u_extent.end_time,
-                altitude_lower=u_extent.spatial_volume.altitude_lo,
-                altitude_upper=u_extent.spatial_volume.altitude_hi,
-                cells=cells,
-                uss_base_url=params["uss_base_url"],
-                subscription_id=subscription_id,
-                state=params.get("state", ""),
-            )
             try:
                 stored, subs = self.store.upsert_operation(op, key)
             except errors.StatusError as e:
                 if e.code == errors.Code.MISSING_OVNS:
-                    # re-search for the full conflict set and attach the
-                    # AirspaceConflictResponse payload
                     ops = self.store.search_operations(
                         cells,
                         u_extent.spatial_volume.altitude_lo,
