@@ -1,24 +1,33 @@
 """North-star benchmark: SCD conflict queries/sec against a 1M-intent DAR.
 
-Fused fast path on one chip (ops/fastpath.py): host cell-range lookup
-(numpy searchsorted) -> one packed H2D upload -> fused device kernel
-(window filter + hit compaction + exact 4D re-check against resident
-per-slot columns) -> one small D2H of packed (query, slot) pairs.
+The table under test is a real serving-stack DarTable (dar/snapshot.py)
+populated via bulk_load — the same immutable-snapshot object the DSS
+service reads — so the headline number runs against the snapshot the
+service would serve, and a second leg measures the full serving path
+(DarTable.query_many via the QueryCoalescer, request-per-thread).
+
 This replaces the reference's per-query SQL conflict scan
 (pkg/scd/store/cockroach/operations.go:374-435); the reference itself
 publishes no numbers (BASELINE.md), so vs_baseline is against the
 BASELINE.json north star of 100k conflict queries/sec.
 
-Three timings:
-  - end-to-end pipelined: submit all batches (async), collect in order
-    — the steady-state service throughput; device work + transfers of
-    batch i+1 overlap the host decode of batch i.
+Legs:
+  - headline pipelined: submit all batches (async) against the
+    DarTable's device snapshot, collect in order — steady-state
+    conflict-check throughput; device work + transfers of batch i+1
+    overlap the host decode of batch i.
   - single-batch latency: one submit+collect with a full sync — the
-    cold request-to-result latency, dominated here by the dev
-    environment's tunneled-TPU dispatch round trip (~100 ms); on a
-    directly-attached chip the same sync is sub-ms.
+    cold request-to-result latency, dominated in this dev environment
+    by the tunneled-TPU dispatch round trip (see dispatch_floor_ms).
   - kernel-only: the fused device kernel re-invoked on device-resident
-    inputs, one sync at the end — the pure device throughput ceiling.
+    inputs — the pure device throughput ceiling.
+  - serving path: N closed-loop client threads issuing single conflict
+    queries through the QueryCoalescer (continuous micro-batching) ->
+    honest p50/p99 + qps through DarTable.query_many, overlay/dead-slot
+    filtering included.  dispatch_floor_ms is the measured minimal
+    device round trip in this environment; on directly-attached TPU it
+    is sub-ms, here the tunnel sets a ~100 ms floor that dominates the
+    serving p50.
 
 Prints ONE JSON line:
   {"metric": ..., "value": qps, "unit": "queries/s", "vs_baseline": x}
@@ -29,97 +38,88 @@ from __future__ import annotations
 import json
 import os
 import sys
+import threading
 import time
 
 import numpy as np
 
 import dss_tpu.ops.conflict as C  # noqa: F401  (enables x64 before jax init)
-from dss_tpu.ops.fastpath import FastTable
+from dss_tpu.dar.coalesce import QueryCoalescer
+from dss_tpu.dar.oracle import Record
+from dss_tpu.dar.snapshot import DarTable
 
 import jax
 import jax.numpy as jnp
 
+HOUR = 3_600_000_000_000
+NOW = 1_700_000_000_000_000_000
 
-def build_fast_table(n_entities: int, n_cells: int, kpe: int, seed: int = 0):
+
+def build_table(n_entities: int, n_cells: int, kpe: int, seed: int = 0):
     """Synthetic dense-urban DAR: n_entities intents, kpe level-13
-    cells each, over an n_cells metro region."""
+    cells each, over an n_cells metro region — loaded into a real
+    serving DarTable."""
     rng = np.random.default_rng(seed)
-    now = 1_700_000_000_000_000_000
-    hour = 3_600_000_000_000
-
-    pk = rng.integers(0, n_cells, n_entities * kpe).astype(np.int32)
-    pe = np.repeat(np.arange(n_entities, dtype=np.int32), kpe)
-    order = np.argsort(pk, kind="stable")
-    pk, pe = pk[order], pe[order]
-
+    keys = np.sort(
+        rng.integers(0, n_cells, (n_entities, kpe)).astype(np.int32), axis=1
+    )
     alt_lo = rng.uniform(0, 3000, n_entities).astype(np.float32)
     alt_hi = alt_lo + rng.uniform(10, 600, n_entities).astype(np.float32)
-    t0 = now + rng.integers(-4, 4, n_entities) * hour
-    t1 = t0 + rng.integers(1, 6, n_entities) * hour
-
-    ft = FastTable(
-        pk, pe,
-        alt_lo[pe], alt_hi[pe], t0[pe], t1[pe],
-        np.ones(len(pe), bool),
-        slot_exact=dict(
-            alt_lo=alt_lo,
-            alt_hi=alt_hi,
-            t0=t0,
-            t1=t1,
-            live=np.ones(n_entities, bool),
-        ),
-    )
-    return ft, now
-
-
-def main():
-    n_entities = int(os.environ.get("DSS_BENCH_ENTITIES", 1_000_000))
-    n_cells = int(os.environ.get("DSS_BENCH_CELLS", 200_000))
-    kpe = 8
-    batch = int(os.environ.get("DSS_BENCH_BATCH", 4096))
-    # a typical op-intent conflict check: the intent's own covering
-    # (~8 level-13 cells), a ~300 m altitude band, a ~1 h window
-    width = int(os.environ.get("DSS_BENCH_WIDTH", 8))
-    reps = int(os.environ.get("DSS_BENCH_REPS", 8))
-
-    ft, now = build_fast_table(n_entities, n_cells, kpe)
-    hour = 3_600_000_000_000
-
-    def make_batch(seed):
-        r = np.random.default_rng(seed)
-        # contiguous cell runs (a footprint covering is spatially local)
-        start = r.integers(0, n_cells - width, batch)
-        keys = (start[:, None] + np.arange(width)[None, :]).astype(np.int32)
-        alo = r.uniform(0, 3000, batch).astype(np.float32)
-        t0 = now + r.integers(-2, 2, batch) * hour
-        return (
-            keys,
-            alo,
-            (alo + 300.0).astype(np.float32),
-            t0.astype(np.int64),
-            (t0 + hour).astype(np.int64),
+    t0 = NOW + rng.integers(-4, 4, n_entities) * HOUR
+    t1 = t0 + rng.integers(1, 6, n_entities) * HOUR
+    records = [
+        Record(
+            entity_id=f"e{i}",
+            keys=keys[i],
+            alt_lo=float(alt_lo[i]),
+            alt_hi=float(alt_hi[i]),
+            t_start=int(t0[i]),
+            t_end=int(t1[i]),
+            owner_id=i & 0xFFFF,
         )
+        for i in range(n_entities)
+    ]
+    table = DarTable(delta_capacity=8192)
+    table.bulk_load(records)
+    return table
 
-    # compile + warmup
-    q0 = make_batch(100)
-    qidx, slots = ft.query_fused(*q0, now=now)
+
+def make_batch(seed, batch, n_cells, width):
+    """A typical op-intent conflict check: the intent's own covering
+    (~width contiguous level-13 cells), a ~300 m altitude band, a ~1 h
+    window."""
+    r = np.random.default_rng(seed)
+    start = r.integers(0, n_cells - width, batch)
+    keys = (start[:, None] + np.arange(width)[None, :]).astype(np.int32)
+    alo = r.uniform(0, 3000, batch).astype(np.float32)
+    t0 = NOW + r.integers(-2, 2, batch) * HOUR
+    return (
+        keys,
+        alo,
+        (alo + 300.0).astype(np.float32),
+        t0.astype(np.int64),
+        (t0 + HOUR).astype(np.int64),
+    )
+
+
+def headline(ft, batch, reps, n_cells, width):
+    """Pipelined fused-path throughput against the serving snapshot."""
+    q0 = make_batch(100, batch, n_cells, width)
+    qidx, slots = ft.query_fused(*q0, now=NOW)  # compile + warmup
     n_hits = len(slots)
+    batches = [make_batch(200 + i, batch, n_cells, width) for i in range(reps)]
 
-    batches = [make_batch(200 + i) for i in range(reps)]
-
-    # -- end-to-end, pipelined: a producer thread submits (host-CPU
-    # work: searchsorted + window packing) while the main thread
-    # collects (mostly waiting on the D2H stream, GIL released), so
+    # a producer thread submits (host work: searchsorted + window
+    # packing) while the main thread collects (D2H wait + decode), so
     # submit(i+1) overlaps collect(i) on top of the device overlap
     import queue as _queue
-    import threading
 
     pend_q: _queue.Queue = _queue.Queue(maxsize=4)
     _DONE = object()  # distinct from submit()'s None (empty batch)
 
     def producer():
         for qb in batches:
-            pend_q.put(ft.submit(*qb, now=now))
+            pend_q.put(ft.submit(*qb, now=NOW))
         pend_q.put(_DONE)
 
     t0 = time.perf_counter()
@@ -129,22 +129,20 @@ def main():
         ft.collect(p)
     th.join()
     dt_pipe = time.perf_counter() - t0
-    qps = batch * reps / dt_pipe
 
-    # -- single-batch latency (full sync per batch)
+    # single-batch latency (full sync per batch)
     lat = []
     for qb in batches[: min(4, reps)]:
         t0 = time.perf_counter()
-        ft.query_fused(*qb, now=now)
+        ft.query_fused(*qb, now=NOW)
         lat.append(time.perf_counter() - t0)
     lat_ms = sorted(lat)[len(lat) // 2] * 1000
 
-    # -- kernel-only: stage one batch's device inputs once, then chain
-    # executions of the fused kernel (no H2D, no host decode; the sync
-    # fetches one scalar-sized slice so the chain actually executes)
+    # kernel-only: stage one batch's device inputs once, then chain
+    # executions of the fused kernel (no H2D, no host decode)
     qb = batches[0]
-    wins, win_q, win_blk, nw = ft._pack_windows(qb[0])
-    t0_eff = np.maximum(qb[3], np.int64(now))  # now folded into t_start
+    wins, _, _, nw = ft._pack_windows(qb[0])
+    t0_eff = np.maximum(qb[3], np.int64(NOW))
     dev_args = (
         ft.b_alo, ft.b_ahi, ft.b_t0, ft.b_t1,
         jnp.asarray(wins),
@@ -152,14 +150,16 @@ def main():
         jnp.asarray(t0_eff), jnp.asarray(qb[4]),
     )
     mw = 1 << 16
-    int(FastTable._fused_xla(*dev_args, max_words=mw)[0])
+    while mw < nw:
+        mw *= 2
+    int(ft._fused_xla(*dev_args, max_words=mw)[0])
     kreps = reps * 4
     t0 = time.perf_counter()
     # vary the time bound by 1ns per rep: defeats any result
     # memoization while keeping the compiled executable and result
     # shapes identical
     outs = [
-        FastTable._fused_xla(
+        ft._fused_xla(
             *dev_args[:7], jnp.asarray(t0_eff + i), dev_args[8],
             max_words=mw,
         )
@@ -168,10 +168,108 @@ def main():
     # chain the executions, then force completion by fetching the last
     # output's count word (a data fetch, not just block_until_ready —
     # the tunneled backend acks readiness before compute finishes)
-    n_words = int(outs[-1][0])
+    int(outs[-1][0])
     dt_kernel = time.perf_counter() - t0
-    kernel_qps = batch * kreps / dt_kernel
+    return {
+        "qps": batch * reps / dt_pipe,
+        "pipelined_batch_ms": dt_pipe / reps * 1000,
+        "single_batch_latency_ms": lat_ms,
+        "kernel_only_qps": batch * kreps / dt_kernel,
+        "warmup_hits_per_query": n_hits / batch,
+    }
 
+
+def dispatch_floor_ms() -> float:
+    """Median minimal device round trip (tiny op + host fetch) — the
+    environment's per-request latency floor, independent of this
+    framework (tunneled dispatch here; sub-ms on attached TPU)."""
+    x = jnp.zeros(8, jnp.float32)
+    float(jnp.sum(x))  # compile
+    ts = []
+    for i in range(10):
+        t0 = time.perf_counter()
+        float(jnp.sum(x + i))
+        ts.append(time.perf_counter() - t0)
+    return sorted(ts)[len(ts) // 2] * 1000
+
+
+def serving_leg(table, n_cells, width, threads, warm_s, run_s):
+    """Closed-loop clients through the QueryCoalescer: the full
+    serving read path (query_many: fused kernel + overlay scan +
+    dead-slot filter + id assembly), continuous micro-batching."""
+    co = QueryCoalescer(table)
+    stop = threading.Event()
+    warm_until = time.perf_counter() + warm_s
+    lats: list = [[] for _ in range(threads)]
+
+    def client(i):
+        r = np.random.default_rng(1000 + i)
+        while not stop.is_set():
+            start = int(r.integers(0, n_cells - width))
+            keys = (start + np.arange(width)).astype(np.int32)
+            alo = float(r.uniform(0, 3000))
+            t0 = NOW + int(r.integers(-2, 2)) * HOUR
+            t_req = time.perf_counter()
+            co.query(keys, alo, alo + 300.0, t0, t0 + HOUR, now=NOW)
+            t_done = time.perf_counter()
+            if t_done >= warm_until:
+                lats[i].append(t_done - t_req)
+
+    ths = [threading.Thread(target=client, args=(i,)) for i in range(threads)]
+    for t in ths:
+        t.start()
+    time.sleep(warm_s + run_s)
+    stop.set()
+    for t in ths:
+        t.join()
+    co.close()
+    all_lats = np.sort(np.concatenate([np.asarray(l) for l in lats]))
+    if len(all_lats) == 0:
+        return {"error": "no samples"}
+    return {
+        "qps": len(all_lats) / run_s,
+        "p50_ms": float(all_lats[len(all_lats) // 2] * 1000),
+        "p99_ms": float(all_lats[int(len(all_lats) * 0.99)] * 1000),
+        "threads": threads,
+        "samples": int(len(all_lats)),
+    }
+
+
+def main():
+    n_entities = int(os.environ.get("DSS_BENCH_ENTITIES", 1_000_000))
+    n_cells = int(os.environ.get("DSS_BENCH_CELLS", 200_000))
+    kpe = 8
+    batch = int(os.environ.get("DSS_BENCH_BATCH", 8192))
+    width = int(os.environ.get("DSS_BENCH_WIDTH", 8))
+    reps = int(os.environ.get("DSS_BENCH_REPS", 12))
+    serving_threads = int(os.environ.get("DSS_BENCH_SERVING_THREADS", 32))
+    serving_secs = float(os.environ.get("DSS_BENCH_SERVING_SECS", 10))
+    do_serving = os.environ.get("DSS_BENCH_SERVING", "1") != "0"
+
+    table = build_table(n_entities, n_cells, kpe)
+    ft = table._state.snap.fast
+
+    h = headline(ft, batch, reps, n_cells, width)
+
+    floor_ms = dispatch_floor_ms()
+    serving = None
+    if do_serving:
+        serving = serving_leg(
+            table, n_cells, width,
+            threads=serving_threads, warm_s=6.0, run_s=serving_secs,
+        )
+        serving["dispatch_floor_ms"] = round(floor_ms, 2)
+        serving["note"] = (
+            "closed-loop through DarTable+QueryCoalescer; p50 rides the"
+            " environment's device round-trip floor (dispatch_floor_ms);"
+            " attached-TPU round trip is sub-ms"
+        )
+        serving = {
+            k: (round(v, 2) if isinstance(v, float) else v)
+            for k, v in serving.items()
+        }
+
+    qps = h["qps"]
     result = {
         "metric": "scd_conflict_qps_1M_intents",
         "value": round(qps, 1),
@@ -182,14 +280,16 @@ def main():
             "cells": n_cells,
             "batch": batch,
             "reps": reps,
-            "pipelined_batch_ms": round(dt_pipe / reps * 1000, 2),
-            "single_batch_latency_ms": round(lat_ms, 2),
-            "kernel_only_qps": round(kernel_qps, 1),
-            "warmup_hits_per_query": round(n_hits / batch, 1),
+            "pipelined_batch_ms": round(h["pipelined_batch_ms"], 2),
+            "single_batch_latency_ms": round(h["single_batch_latency_ms"], 2),
+            "kernel_only_qps": round(h["kernel_only_qps"], 1),
+            "warmup_hits_per_query": round(h["warmup_hits_per_query"], 1),
+            "dispatch_floor_ms": round(floor_ms, 2),
+            "serving": serving,
             "backend": jax.devices()[0].platform,
             "device": str(jax.devices()[0]),
-            "pipeline": "fused: host-searchsorted + device filter"
-                        "+compact+exact, pipelined submits",
+            "pipeline": "DarTable snapshot; fused: host-searchsorted +"
+                        " device filter+compact+exact, pipelined submits",
         },
     }
     print(json.dumps(result))

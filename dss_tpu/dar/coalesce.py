@@ -89,10 +89,16 @@ class QueryCoalescer:
             raise item.error
         return item.result
 
-    def close(self):
+    def close(self, join: bool = True, timeout: float = 30.0):
+        """Stop accepting queries and (by default) wait for the worker
+        to drain — joining prevents the interpreter tearing down the
+        device runtime while the worker is mid-dispatch."""
         with self._cond:
             self._closed = True
             self._cond.notify_all()
+            th = self._thread
+        if join and th is not None and th is not threading.current_thread():
+            th.join(timeout)
 
     # -- worker --------------------------------------------------------------
 
