@@ -90,6 +90,14 @@ def make_parser() -> argparse.ArgumentParser:
         "bound on non-writing instances)",
     )
     p.add_argument(
+        "--region_snapshot_every",
+        type=int,
+        default=512,
+        help="upload a state snapshot to the region log every N "
+        "entries (bounds late-join/resync replay; the log compacts "
+        "below the snapshot)",
+    )
+    p.add_argument(
         "--instance_id",
         default="",
         help="stable identity of this DSS instance within the region",
@@ -116,6 +124,7 @@ def build(args) -> web.Application:
         region_url=args.region_url or None,
         region_token=region_token or None,
         region_poll_interval_s=args.region_poll_interval,
+        region_snapshot_every=args.region_snapshot_every,
         instance_id=args.instance_id or None,
     )
     log.info(

@@ -71,6 +71,40 @@ def _bump_sub(subs: Dict[str, object], sub_id: str):
     return bumped
 
 
+class _TxnTimeMixin:
+    """Per-transaction pinned 'now' (the stand-in for CRDB's txn
+    timestamp): every visibility/expiry check inside one transaction
+    reads the same instant, so a precheck and the mutation that follows
+    it can never disagree about which records are visible (a record
+    expiring mid-txn would otherwise abort the txn after journaling).
+    Thread-local so lock-free readers keep their own wall-clock now."""
+
+    def _init_txn_time(self):
+        self._txn_time = threading.local()
+
+    @contextlib.contextmanager
+    def _txn_scope(self):
+        with self._txn():
+            tl = self._txn_time
+            outer = getattr(tl, "now", None) is None
+            if outer:
+                tl.now = to_nanos(self._clock.now())
+            try:
+                yield
+            finally:
+                if outer:
+                    tl.now = None
+
+    def _now_ns(self) -> int:
+        pinned = getattr(self._txn_time, "now", None)
+        return pinned if pinned is not None else to_nanos(self._clock.now())
+
+    @contextlib.contextmanager
+    def transaction(self):
+        with self._txn_scope():
+            yield self
+
+
 class TimestampOracle:
     """Strictly-increasing commit timestamps (microsecond granularity),
     the stand-in for CRDB's transaction_timestamp()."""
@@ -106,7 +140,7 @@ class OwnerInterner:
             return self._ids.setdefault(owner, len(self._ids))
 
 
-class RIDStoreImpl(RIDStore):
+class RIDStoreImpl(_TxnTimeMixin, RIDStore):
     def __init__(
         self, *, clock, ts_oracle, owners, lock, journal, index_factory,
         txn=None, capture_undo=False,
@@ -122,6 +156,7 @@ class RIDStoreImpl(RIDStore):
         # records that revert the mutation) so the coordinator can roll
         # back an aborted txn precisely instead of resyncing from the log
         self._capture_undo = capture_undo
+        self._init_txn_time()
         self._isas: Dict[str, ridm.IdentificationServiceArea] = {}
         self._subs: Dict[str, ridm.Subscription] = {}
         self._isa_index = index_factory()
@@ -134,13 +169,25 @@ class RIDStoreImpl(RIDStore):
         self._isa_index = self._index_factory()
         self._sub_index = self._index_factory()
 
-    @contextlib.contextmanager
-    def transaction(self):
-        with self._txn():
-            yield self
+    def serialize_state(self) -> dict:
+        """Full-state snapshot as plain JSON docs (region snapshot
+        upload; the CRDB-range-snapshot analog)."""
+        return {
+            "isas": [codec.isa_to_doc(x) for x in self._isas.values()],
+            "subs": [codec.rid_sub_to_doc(x) for x in self._subs.values()],
+        }
 
-    def _now_ns(self) -> int:
-        return to_nanos(self._clock.now())
+    def restore_state(self, state: dict) -> None:
+        self.reset_state()
+        for d in state.get("isas", []):
+            isa = codec.doc_to_isa(d)
+            self._isas[isa.id] = isa
+            self._index_isa(isa)
+        for d in state.get("subs", []):
+            sub = codec.doc_to_rid_sub(d)
+            self._subs[sub.id] = sub
+            self._index_sub(sub)
+
 
     # -- ISAs ----------------------------------------------------------------
 
@@ -168,7 +215,7 @@ class RIDStoreImpl(RIDStore):
         )
 
     def insert_isa(self, isa):
-        with self._txn():
+        with self._txn_scope():
             old = self._isas.get(isa.id)
             if isa.version is None or isa.version.empty:
                 if old is not None:
@@ -194,7 +241,7 @@ class RIDStoreImpl(RIDStore):
             return dataclasses.replace(stored)
 
     def delete_isa(self, isa):
-        with self._txn():
+        with self._txn_scope():
             old = self._isas.get(isa.id)
             if (
                 old is None
@@ -249,7 +296,7 @@ class RIDStoreImpl(RIDStore):
         )
 
     def insert_subscription(self, sub):
-        with self._txn():
+        with self._txn_scope():
             old = self._subs.get(sub.id)
             if sub.version is None or sub.version.empty:
                 if old is not None:
@@ -275,7 +322,7 @@ class RIDStoreImpl(RIDStore):
             return dataclasses.replace(stored)
 
     def delete_subscription(self, sub):
-        with self._txn():
+        with self._txn_scope():
             old = self._subs.get(sub.id)
             if (
                 old is None
@@ -324,7 +371,7 @@ class RIDStoreImpl(RIDStore):
         )
 
     def update_notification_idxs_in_cells(self, cells):
-        with self._txn():
+        with self._txn_scope():
             ids = self._sub_index.query_ids(cells, now=self._now_ns())
             out = []
             undo = []
@@ -368,7 +415,7 @@ class RIDStoreImpl(RIDStore):
                 _bump_sub(self._subs, i)
 
 
-class SCDStoreImpl(SCDStore):
+class SCDStoreImpl(_TxnTimeMixin, SCDStore):
     def index_stats(self) -> dict:
         return self._op_index.stats()
 
@@ -387,6 +434,7 @@ class SCDStoreImpl(SCDStore):
         self._journal = journal
         self._index_factory = index_factory
         self._capture_undo = capture_undo
+        self._init_txn_time()
         self._ops: Dict[str, scdm.Operation] = {}
         self._subs: Dict[str, scdm.Subscription] = {}
         self._op_index = index_factory()
@@ -399,13 +447,25 @@ class SCDStoreImpl(SCDStore):
         self._op_index = self._index_factory()
         self._sub_index = self._index_factory()
 
-    @contextlib.contextmanager
-    def transaction(self):
-        with self._txn():
-            yield self
+    def serialize_state(self) -> dict:
+        """Full-state snapshot as plain JSON docs (region snapshot
+        upload; the CRDB-range-snapshot analog)."""
+        return {
+            "ops": [codec.op_to_doc(x) for x in self._ops.values()],
+            "subs": [codec.scd_sub_to_doc(x) for x in self._subs.values()],
+        }
 
-    def _now_ns(self) -> int:
-        return to_nanos(self._clock.now())
+    def restore_state(self, state: dict) -> None:
+        self.reset_state()
+        for d in state.get("ops", []):
+            op = codec.doc_to_op(d)
+            self._ops[op.id] = op
+            self._index_op(op)
+        for d in state.get("subs", []):
+            sub = codec.doc_to_scd_sub(d)
+            self._subs[sub.id] = sub
+            self._index_scd_sub(sub)
+
 
     def _visible_op(self, id) -> Optional[scdm.Operation]:
         """Expired operations are invisible (operations.go:103-112)."""
@@ -496,10 +556,13 @@ class SCDStoreImpl(SCDStore):
             self._journal(rec)
         return out
 
-    def _precheck_op_upsert(self, op, key):
+    def _precheck_op_upsert(self, op, key, *, check_key: bool = True):
         """All upsert preconditions (version fencing, ownership, time
         range, OVN key check — operations.go:305-364), no mutation.
-        Returns the old record (or None)."""
+        Returns the old record (or None).  check_key=False skips the
+        (expensive) OVN conflict search — only valid when the caller
+        already ran it inside the same transaction scope (the pinned
+        txn timestamp guarantees the same visibility answers)."""
         old = self._visible_op(op.id)
         if old is None and op.version != 0:
             raise errors.not_found(op.id)
@@ -513,7 +576,7 @@ class SCDStoreImpl(SCDStore):
             )
         op.validate_time_range()
 
-        if op.state in scdm.OperationState.REQUIRES_KEY:
+        if check_key and op.state in scdm.OperationState.REQUIRES_KEY:
             conflicting = self._search_ops(
                 op.cells,
                 op.altitude_lower,
@@ -531,36 +594,44 @@ class SCDStoreImpl(SCDStore):
         """Read-only precheck, run by the service BEFORE any journaled
         mutation (e.g. the implicit subscription) so a rejected conflict
         — a routine outcome — aborts the transaction with an empty
-        journal buffer: nothing to roll back, no region resync.
-        upsert_operation re-runs the same checks under the same txn, so
-        the answers agree."""
-        with self._txn():
+        journal buffer: nothing to roll back, no region resync.  The
+        upsert that follows (with key_checked=True) re-runs only the
+        cheap fencing checks; the pinned per-txn timestamp keeps both
+        passes' visibility answers identical."""
+        with self._txn_scope():
             self._precheck_op_upsert(op, key)
 
-    def upsert_operation(self, op, key):
-        with self._txn():
-            old = self._precheck_op_upsert(op, key)
+    def upsert_operation(self, op, key, *, key_checked: bool = False):
+        with self._txn_scope():
+            old = self._precheck_op_upsert(
+                op, key, check_key=not key_checked
+            )
             ts = self._ts.commit_ts()
             stored = dataclasses.replace(
                 op,
                 version=(old.version if old else 0) + 1,
                 ovn=new_ovn_from_time(ts, op.id),
             )
+            if self._capture_undo:
+                # exact inverse: restore whatever the id maps to NOW,
+                # including an expired (invisible) record `old` misses
+                prev_raw = self._ops.get(op.id)
+                undo = [
+                    {"t": "scd_op_put", "doc": codec.op_to_doc(prev_raw)}
+                    if prev_raw is not None
+                    else {"t": "scd_op_del", "id": stored.id}
+                ]
             self._ops[stored.id] = stored
             self._index_op(stored)
             rec = {"t": "scd_op_put", "doc": codec.op_to_doc(stored)}
             if self._capture_undo:
-                rec["undo"] = [
-                    {"t": "scd_op_put", "doc": codec.op_to_doc(old)}
-                    if old is not None
-                    else {"t": "scd_op_del", "id": stored.id}
-                ]
+                rec["undo"] = undo
             self._journal(rec)
             subs = self._notify_subs_locked(stored.cells)
             return dataclasses.replace(stored), subs
 
     def delete_operation(self, id, owner):
-        with self._txn():
+        with self._txn_scope():
             old = self._visible_op(id)
             if old is None:
                 raise errors.not_found(id)
@@ -615,7 +686,7 @@ class SCDStoreImpl(SCDStore):
         return out
 
     def upsert_subscription(self, sub):
-        with self._txn():
+        with self._txn_scope():
             old = self._visible_sub(sub.id)
             if old is None and sub.version != 0:
                 raise errors.not_found(sub.id)
@@ -638,15 +709,20 @@ class SCDStoreImpl(SCDStore):
             stored = dataclasses.replace(
                 sub, version=(old.version if old else 0) + 1
             )
+            if self._capture_undo:
+                # exact inverse: raw get includes an expired (invisible)
+                # record that `old` (visibility-filtered) misses
+                prev_raw = self._subs.get(sub.id)
+                undo = [
+                    {"t": "scd_sub_put", "doc": codec.scd_sub_to_doc(prev_raw)}
+                    if prev_raw is not None
+                    else {"t": "scd_sub_del", "id": stored.id}
+                ]
             self._subs[stored.id] = stored
             self._index_scd_sub(stored)
             rec = {"t": "scd_sub_put", "doc": codec.scd_sub_to_doc(stored)}
             if self._capture_undo:
-                rec["undo"] = [
-                    {"t": "scd_sub_put", "doc": codec.scd_sub_to_doc(old)}
-                    if old is not None
-                    else {"t": "scd_sub_del", "id": stored.id}
-                ]
+                rec["undo"] = undo
             self._journal(rec)
             affected = (
                 self._search_ops(
@@ -662,7 +738,7 @@ class SCDStoreImpl(SCDStore):
             return dataclasses.replace(stored), affected
 
     def delete_subscription(self, id, owner, version):
-        with self._txn():
+        with self._txn_scope():
             old = self._visible_sub(id)
             if old is None:
                 raise errors.not_found(id)
@@ -755,6 +831,7 @@ class DSSStore:
         region_url: Optional[str] = None,
         region_token: Optional[str] = None,
         region_poll_interval_s: float = 0.05,
+        region_snapshot_every: int = 512,
         instance_id: Optional[str] = None,
     ):
         if storage == "tpu":
@@ -792,6 +869,7 @@ class DSSStore:
             journal=self._journal,
             index_factory=index_factory,
             txn=txn,
+            capture_undo=bool(region_url),
         )
         self.scd = SCDStoreImpl(
             clock=self.clock,
@@ -801,6 +879,7 @@ class DSSStore:
             journal=self._journal,
             index_factory=index_factory,
             txn=txn,
+            capture_undo=bool(region_url),
         )
         self._replaying = False
         if region_url:
@@ -810,6 +889,7 @@ class DSSStore:
                 self.scd,
                 self._lock,
                 poll_interval_s=region_poll_interval_s,
+                snapshot_every=region_snapshot_every,
             )
             self.region.bootstrap()
         else:

@@ -124,10 +124,13 @@ class SCDStore(abc.ABC):
 
     @abc.abstractmethod
     def upsert_operation(
-        self, op: scdm.Operation, key: List[str]
+        self, op: scdm.Operation, key: List[str], *, key_checked: bool = False
     ) -> Tuple[scdm.Operation, List[scdm.Subscription]]:
         """Fenced upsert with the OVN key check for Accepted/Activated
-        states; returns (op, subscriptions-to-notify, post-bump)."""
+        states; returns (op, subscriptions-to-notify, post-bump).
+        key_checked=True skips the OVN conflict search — only valid
+        when validate_operation_upsert already ran inside the same
+        transaction (the pinned txn timestamp keeps answers equal)."""
 
     @abc.abstractmethod
     def validate_operation_upsert(self, op: scdm.Operation, key: List[str]) -> None:

@@ -26,11 +26,20 @@ Consistency properties:
   - reads on other instances: bounded staleness = tail-poll interval
     (default 50 ms) + transfer; monotonic (records apply in log order).
   - crash recovery: an instance that fails an append (lease fenced) or
-    restarts resynchronizes by replaying the full log from the region
-    server, which owns durability via its write-ahead file.
+    restarts resynchronizes from the latest state snapshot + the log
+    tail after it; the region server owns durability via its
+    write-ahead file and compacts entries below the snapshot, so
+    recovery cost is bounded by snapshot interval, not history length.
+  - txn rollback: an aborted local transaction that already journaled
+    records is undone record-by-record from captured undo state — no
+    resync, nothing region-visible.
 """
 
-from dss_tpu.region.client import RegionClient, RegionError
+from dss_tpu.region.client import (
+    RegionClient,
+    RegionError,
+    SnapshotRequired,
+)
 from dss_tpu.region.coordinator import RegionCoordinator
 from dss_tpu.region.log_server import build_region_app
 
@@ -38,5 +47,6 @@ __all__ = [
     "RegionClient",
     "RegionCoordinator",
     "RegionError",
+    "SnapshotRequired",
     "build_region_app",
 ]

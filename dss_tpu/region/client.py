@@ -13,6 +13,11 @@ class RegionError(RuntimeError):
     """Region log unreachable, lease unavailable, or append fenced."""
 
 
+class SnapshotRequired(RegionError):
+    """The requested log range was compacted away; fetch the snapshot
+    and resume from its index."""
+
+
 class RegionClient:
     def __init__(
         self,
@@ -37,9 +42,22 @@ class RegionClient:
     def _json(r) -> dict:
         """Parse a response body, tolerating non-JSON error pages."""
         try:
-            return r.json()
+            body = r.json()
         except ValueError:
             return {}
+        return body if isinstance(body, dict) else {}
+
+    @staticmethod
+    def _field(body: dict, key: str, caster, what: str):
+        """Extract+cast a required response field; any malformed server
+        response surfaces as RegionError (-> 503 UNAVAILABLE), never as
+        a bare KeyError/TypeError escaping as an internal 500."""
+        try:
+            return caster(body[key])
+        except (KeyError, TypeError, ValueError) as e:
+            raise RegionError(
+                f"malformed region response ({what}): {e!r}"
+            ) from e
 
     def acquire_lease(self) -> int:
         """Blocking acquire with backoff; -> fencing token."""
@@ -58,7 +76,7 @@ class RegionClient:
             except requests.RequestException as e:
                 raise RegionError(f"region log unreachable: {e}") from e
             if r.status_code == 200:
-                return int(self._json(r)["token"])
+                return self._field(self._json(r), "token", int, "lease")
             if r.status_code == 401:
                 raise RegionError("region auth rejected (bad token)")
             if time.monotonic() >= deadline:
@@ -80,8 +98,9 @@ class RegionClient:
             pass  # lease expires on its own TTL
 
     def append(self, token: int, records: List[dict]) -> int:
-        """-> index of the first appended record.  Raises RegionError if
-        the lease was fenced (caller must resync)."""
+        """Append one entry (this txn's whole batch) -> its entry
+        index.  Raises RegionError if the lease was fenced (caller must
+        resync)."""
         try:
             r = self._session.post(
                 f"{self.base}/append",
@@ -92,18 +111,69 @@ class RegionClient:
             raise RegionError(f"region append failed: {e}") from e
         if r.status_code != 200:
             raise RegionError(f"region append fenced: {r.text}")
-        return int(self._json(r)["from_index"])
+        return self._field(self._json(r), "index", int, "append")
 
-    def fetch(self, from_index: int) -> Tuple[List[Tuple[int, dict]], int]:
-        """-> ([(index, record), ...], head)."""
+    def fetch(
+        self, from_index: int
+    ) -> Tuple[List[Tuple[int, List[dict]]], int]:
+        """-> ([(entry_index, [record, ...]), ...], head).  Raises
+        SnapshotRequired when from_index predates log compaction."""
         try:
             r = self._session.get(
                 f"{self.base}/records",
                 params={"from": from_index},
                 timeout=self._timeout,
             )
-            r.raise_for_status()
         except requests.RequestException as e:
             raise RegionError(f"region fetch failed: {e}") from e
         body = self._json(r)
-        return [(int(i), rec) for i, rec in body["records"]], int(body["head"])
+        if r.status_code == 409 and body.get("snapshot_required"):
+            raise SnapshotRequired(
+                f"log compacted up to {body.get('snapshot_index')}"
+            )
+        if r.status_code != 200:
+            raise RegionError(f"region fetch failed: {r.status_code}")
+        entries = self._field(body, "entries", list, "fetch")
+        head = self._field(body, "head", int, "fetch")
+        try:
+            return (
+                [(int(i), list(recs)) for i, recs in entries],
+                head,
+            )
+        except (TypeError, ValueError) as e:
+            raise RegionError(
+                f"malformed region response (fetch entries): {e!r}"
+            ) from e
+
+    def get_snapshot(self) -> Optional[Tuple[int, dict]]:
+        """-> (entry_index, state) of the latest snapshot, or None."""
+        try:
+            r = self._session.get(
+                f"{self.base}/snapshot", timeout=self._timeout
+            )
+        except requests.RequestException as e:
+            raise RegionError(f"region snapshot fetch failed: {e}") from e
+        if r.status_code == 404:
+            return None
+        if r.status_code != 200:
+            raise RegionError(
+                f"region snapshot fetch failed: {r.status_code}"
+            )
+        body = self._json(r)
+        return (
+            self._field(body, "index", int, "snapshot"),
+            self._field(body, "state", dict, "snapshot"),
+        )
+
+    def put_snapshot(self, index: int, state: dict) -> bool:
+        """Upload a state snapshot as of entry `index`.  False if the
+        server rejected it as stale (another instance got there first)."""
+        try:
+            r = self._session.post(
+                f"{self.base}/snapshot",
+                json={"index": index, "state": state},
+                timeout=max(self._timeout, 30.0),
+            )
+        except requests.RequestException as e:
+            raise RegionError(f"region snapshot upload failed: {e}") from e
+        return r.status_code == 200

@@ -7,27 +7,44 @@ implementation_details.md:11-42).  One coordinator per DSS instance:
   WRITE PATH (region-serializable, lease-fenced):
     txn() wraps every logical store mutation.  The outermost entry
       1. acquires the region write lease (fencing token),
-      2. catches up to the log head (applies remote records),
+      2. catches up to the log head (applies remote entries),
       3. runs the local validation + mutation (journal records are
          buffered, not written),
-      4. appends the buffered records to the region log as ONE atomic
-         batch at exactly the local applied index,
-      5. advances the applied index and releases the lease.
+      4. appends the buffered records to the region log as ONE entry
+         (the txn's atomic batch) at exactly the local applied index,
+      5. advances the applied index, uploads a state snapshot every
+         `snapshot_every` entries, and releases the lease.
     Validation therefore always runs against region-current state, and
     the writing instance has read-your-writes (local apply precedes the
-    ack).  Any divergence (fenced append, local apply without a logged
-    batch) triggers a full resync from the log.
+    ack).
+
+  ROLLBACK:
+    an aborted txn that already journaled records is rolled back
+    record-by-record from the per-record "undo" lists the store
+    captures (capture_undo) — the reference's txn rollback analog.
+    The same rollback covers append failures: a fenced append (batch
+    definitely not logged) leaves rolled-back state final; an
+    ambiguous network failure (batch MAY have been logged) rolls back
+    to the last log-consistent point and the tail poller re-applies
+    the batch from the log if it landed.  Either way local state
+    converges to the log without a resync; a full resync remains only
+    for dirty recovery and falling behind compaction.
 
   READ PATH (bounded staleness, monotonic):
     a daemon thread tail-polls the log every `poll_interval_s` and
-    applies new records under the store lock, in log order.  Staleness
-    on a non-writing instance is bounded by poll interval + transfer.
+    applies new ENTRIES under the store lock, each entry's records
+    together, in log order — a writer's transaction becomes visible as
+    a unit (entry = txn batch).  Staleness on a non-writing instance is
+    bounded by poll interval + transfer.
 
-  RECOVERY:
-    boot = full replay of the region log (the log server owns
-    durability via its own WAL); a fenced or failed writer resyncs from
-    scratch the same way, mirroring how the reference treats the DAR
-    snapshot as a cache of the database (SURVEY.md §5).
+  RECOVERY (bounded by snapshots):
+    boot/late-join/resync fetch the latest state snapshot + the log
+    tail after it, instead of replaying from index 0; the log server
+    compacts entries below the snapshot (log_server.put_snapshot).
+    Resync fetches everything over the network FIRST and only then
+    swaps local state, so a failed resync leaves the previous
+    (stale-but-consistent) state serving reads while writes refuse
+    with UNAVAILABLE until clean.
 """
 
 from __future__ import annotations
@@ -36,10 +53,14 @@ import contextlib
 import logging
 import threading
 import time
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
 from dss_tpu import errors
-from dss_tpu.region.client import RegionClient, RegionError
+from dss_tpu.region.client import (
+    RegionClient,
+    RegionError,
+    SnapshotRequired,
+)
 
 log = logging.getLogger("dss.region")
 
@@ -53,16 +74,22 @@ class RegionCoordinator:
         lock: threading.RLock,
         *,
         poll_interval_s: float = 0.05,
+        snapshot_every: int = 512,
     ):
         self._client = client
         self._rid = rid_store
         self._scd = scd_store
         self._lock = lock
         self._poll_s = poll_interval_s
-        self._applied = 0  # next log index to apply
+        self._snapshot_every = max(int(snapshot_every), 1)
+        self._applied = 0  # next log ENTRY index to apply
+        self._last_snapshot = 0  # entry index of the last snapshot upload
+        self._pending_snapshot: Optional[Tuple[int, dict]] = None
         self._buffer: Optional[List[dict]] = None  # active txn's records
         self._depth = 0  # txn nesting (guarded by lock)
         self._dirty = False  # local state diverged; resync required
+        self._resyncs = 0
+        self._rollbacks = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -86,7 +113,7 @@ class RegionCoordinator:
         self._buffer.append(rec)
 
     def bootstrap(self) -> None:
-        """Initial full catch-up from the log, then start tail polling."""
+        """Initial catch-up (snapshot + tail), then start tail polling."""
         with self._lock:
             self._catch_up_locked()
         self._thread = threading.Thread(
@@ -103,6 +130,8 @@ class RegionCoordinator:
         return {
             "region_applied": self._applied,
             "region_dirty": int(self._dirty),
+            "region_resyncs": self._resyncs,
+            "region_rollbacks": self._rollbacks,
         }
 
     # -- write-through transaction -------------------------------------------
@@ -122,8 +151,12 @@ class RegionCoordinator:
 
             if self._dirty:
                 # a previous failure left local state diverged; restore
-                # before accepting writes (reads were already suspect)
-                self._resync_locked()
+                # before accepting writes (reads serve the stale-but-
+                # consistent previous state meanwhile)
+                try:
+                    self._resync_locked()
+                except RegionError as e:
+                    raise errors.unavailable(f"region resync: {e}")
 
             try:
                 token = self._client.acquire_lease()
@@ -140,9 +173,9 @@ class RegionCoordinator:
                     yield
                 except BaseException:
                     if self._buffer:
-                        # mutated locally but nothing logged: roll back
-                        # by resyncing from the log
-                        self._resync_or_mark_dirty()
+                        # mutated locally but nothing was logged: undo
+                        # the buffered records (txn rollback)
+                        self._rollback_locked(self._buffer)
                     raise
                 finally:
                     buf, self._buffer = self._buffer, None
@@ -153,22 +186,91 @@ class RegionCoordinator:
                 self._client.release_lease(token)
 
     def _commit_locked(self, token: int, buf: List[dict]) -> None:
+        # "undo" lists are local rollback state, not region history
+        wire = [
+            {k: v for k, v in rec.items() if k != "undo"} for rec in buf
+        ]
         try:
-            idx = self._client.append(token, buf)
+            idx = self._client.append(token, wire)
         except RegionError as e:
-            self._resync_or_mark_dirty()
+            # Fenced (definite no-append) or network error (append
+            # MAY have landed): either way, undo the local mutations —
+            # local state returns to the last log-consistent point, and
+            # if the append did land the tail poller re-applies it from
+            # the log.  Converges without a resync in both cases.
+            self._rollback_locked(buf)
             raise errors.unavailable(
-                f"region append fenced; local state resynced: {e}"
+                f"region append failed; local txn rolled back "
+                f"(re-applied from the log if it landed): {e}"
             )
         if idx != self._applied:
             # someone slipped between our catch-up and append — the
-            # lease should make this impossible, so treat as fencing
-            self._resync_or_mark_dirty()
+            # lease should make this impossible.  The batch IS in the
+            # log at idx: undo locally and let the poller apply the
+            # intervening entries + ours in log order.
+            self._rollback_locked(buf)
             raise errors.unavailable(
                 f"region log order broke (appended at {idx}, expected "
-                f"{self._applied}); local state resynced"
+                f"{self._applied}); rolled back, converging via the log"
             )
-        self._applied += len(buf)
+        self._applied += 1
+        self._maybe_snapshot_locked()
+
+    def _rollback_locked(self, buf: List[dict]) -> None:
+        """Undo an aborted txn's journaled records in reverse order.
+        Falls back to a full resync only if a record carries no undo
+        list (capture_undo disabled — shouldn't happen in region mode)."""
+        if not all("undo" in rec for rec in buf):
+            log.warning(
+                "txn abort without undo info; falling back to resync"
+            )
+            self._resync_or_mark_dirty()
+            return
+        for rec in reversed(buf):
+            for u in reversed(rec["undo"]):
+                self._apply_locked(u)
+        self._rollbacks += 1
+
+    def _maybe_snapshot_locked(self) -> None:
+        """Serialize a state snapshot every snapshot_every entries and
+        hand it to the tail poller for upload OUTSIDE the store lock —
+        the commit path only pays the in-memory serialization, never
+        the HTTP round trip.  Best-effort: a failed or rejected upload
+        only delays compaction by one interval."""
+        if self._pending_snapshot is not None:
+            return
+        if self._applied - self._last_snapshot < self._snapshot_every:
+            return
+        state = {
+            "rid": self._rid.serialize_state(),
+            "scd": self._scd.serialize_state(),
+        }
+        self._pending_snapshot = (self._applied, state)
+
+    def _upload_pending_snapshot(self) -> None:
+        """Poller-thread side of _maybe_snapshot_locked (no lock held
+        during the upload)."""
+        pend = self._pending_snapshot
+        if pend is None:
+            return
+        idx, state = pend
+        try:
+            if not self._client.put_snapshot(idx, state):
+                log.warning(
+                    "region snapshot at %d rejected; backing off one "
+                    "interval", idx,
+                )
+        except RegionError as e:
+            log.warning(
+                "region snapshot upload at %d failed (%s); backing off "
+                "one interval", idx, e,
+            )
+        finally:
+            with self._lock:
+                # advance even on failure: back off instead of
+                # re-serializing state on every subsequent commit
+                self._last_snapshot = max(self._last_snapshot, idx)
+                self._pending_snapshot = None
 
     # -- apply / resync (store lock held) ------------------------------------
 
@@ -179,38 +281,94 @@ class RegionCoordinator:
         else:
             self._scd.apply_wal(rec)
 
+    def _apply_entry_locked(self, recs: List[dict]) -> None:
+        for rec in recs:
+            self._apply_locked(rec)
+
+    def _restore_snapshot_locked(self, index: int, state: dict) -> None:
+        self._rid.restore_state(state.get("rid", {}))
+        self._scd.restore_state(state.get("scd", {}))
+        self._applied = index
+        self._last_snapshot = index
+
     def _catch_up_locked(self) -> None:
         while True:
-            recs, head = self._client.fetch(self._applied)
-            for idx, rec in recs:
+            try:
+                entries, head = self._client.fetch(self._applied)
+            except SnapshotRequired:
+                snap = self._client.get_snapshot()
+                if snap is None:
+                    raise RegionError(
+                        "log compacted but no snapshot available"
+                    )
+                self._restore_snapshot_locked(*snap)
+                continue
+            for idx, recs in entries:
                 if idx >= self._applied:
-                    self._apply_locked(rec)
+                    self._apply_entry_locked(recs)
                     self._applied = idx + 1
             if self._applied >= head:
                 return
 
     def _resync_locked(self) -> None:
-        log.warning("region resync: dropping local state, replaying log")
+        """Rebuild local state from snapshot + tail.  All network
+        fetches happen BEFORE any local state is touched, so a region
+        outage mid-resync leaves the previous state intact (reads stay
+        consistent; writes refuse while dirty)."""
+        self._resyncs += 1
+        log.warning("region resync: fetching snapshot + log tail")
+        snap = None
+        start = 0
+        try:
+            fetched: List[Tuple[int, List[dict]]] = []
+            try:
+                entries, head = self._client.fetch(start)
+            except SnapshotRequired:
+                snap = self._client.get_snapshot()
+                if snap is None:
+                    raise RegionError(
+                        "log compacted but no snapshot available"
+                    )
+                start = snap[0]
+                entries, head = self._client.fetch(start)
+            while True:
+                fetched.extend(entries)
+                nxt = (
+                    fetched[-1][0] + 1 if fetched else start
+                )
+                if nxt >= head:
+                    break
+                entries, head = self._client.fetch(nxt)
+        except RegionError:
+            self._dirty = True
+            raise
+        # network done — swap state locally (no I/O below)
         self._rid.reset_state()
         self._scd.reset_state()
         self._applied = 0
-        self._catch_up_locked()
+        if snap is not None:
+            self._restore_snapshot_locked(*snap)
+        for idx, recs in fetched:
+            if idx >= self._applied:
+                self._apply_entry_locked(recs)
+                self._applied = idx + 1
         self._dirty = False
 
     def _resync_or_mark_dirty(self) -> None:
         try:
             self._resync_locked()
         except RegionError as e:
-            # region unreachable: mark diverged; the tail poller keeps
-            # retrying, and writes refuse until clean
+            # region unreachable: previous state keeps serving reads
+            # (stale but consistent); writes refuse until the tail
+            # poller completes a resync
             log.error("region resync failed (%s); marking dirty", e)
-            self._dirty = True
 
     # -- tail poller ----------------------------------------------------------
 
     def _poll_loop(self) -> None:
         while not self._stop.wait(self._poll_s):
             try:
+                self._upload_pending_snapshot()
                 if self._dirty:
                     with self._lock:
                         if self._dirty:
@@ -218,13 +376,19 @@ class RegionCoordinator:
                     continue
                 # fetch over HTTP without the lock; the idx guard under
                 # the lock drops anything applied concurrently
-                recs, _head = self._client.fetch(self._applied)
-                if not recs:
+                try:
+                    entries, _head = self._client.fetch(self._applied)
+                except SnapshotRequired:
+                    # we fell behind compaction: full snapshot restore
+                    with self._lock:
+                        self._resync_locked()
+                    continue
+                if not entries:
                     continue
                 with self._lock:
-                    for idx, rec in recs:
+                    for idx, recs in entries:
                         if idx >= self._applied:
-                            self._apply_locked(rec)
+                            self._apply_entry_locked(recs)
                             self._applied = idx + 1
             except RegionError:
                 continue  # transient; next tick retries

@@ -1,20 +1,36 @@
-"""The region log server: ordered durable record log + write lease.
+"""The region log server: ordered durable batch log + write lease +
+state snapshots.
 
 The CRDB-cluster stand-in for a DSS Region (README.md:22-49).  One
 asyncio process holds:
 
-  - an append-only record log, persisted through WriteAheadLog so a
-    restarted region recovers its full history;
+  - an append-only log of ENTRIES, persisted through WriteAheadLog so a
+    restarted region recovers its full history.  Each entry is one
+    writer transaction's whole record batch — shipping the txn boundary
+    means tail readers apply a transaction atomically instead of
+    record-by-record (the reference gets this from CRDB's atomic txn
+    visibility);
   - a single TTL write lease; appends are fenced by the lease token,
     so a paused/partitioned writer whose lease expired cannot corrupt
-    the order (the fencing-token pattern).
+    the order (the fencing-token pattern);
+  - an optional state snapshot uploaded by an instance (the serialized
+    store state as of entry index N).  Boot/late-join/resync fetch
+    snapshot + tail instead of replaying from 0, and the log compacts
+    entries below the snapshot index — bounded recovery, the role
+    CRDB's range snapshots + raft log truncation play in the reference
+    (implementation_details.md:11-42).
 
 Endpoints (JSON over HTTP — the DCN transport stand-in):
   POST   /lease    {holder, ttl_s}        -> {token} | 409 {holder}
   DELETE /lease    {token}                -> {}
-  POST   /append   {token, records}       -> {from_index} | 409
-  GET    /records?from=N&limit=M          -> {records: [[idx, rec]...],
-                                              head: int}
+  POST   /append   {token, records}       -> {index} | 409
+  GET    /records?from=N&limit=M          -> {entries: [[idx, [rec...]]
+                                              ...], head: int}
+                                           | 409 {snapshot_required,
+                                              snapshot_index} when N
+                                              predates compaction
+  POST   /snapshot {index, state}         -> {} | 409 (stale index)
+  GET    /snapshot                        -> {index, state} | 404
   GET    /healthy
 
 Auth: when built with `auth_token`, every endpoint except /healthy
@@ -27,6 +43,7 @@ unauthenticated write surface into authoritative state.
 
 from __future__ import annotations
 
+import asyncio
 import hmac
 import time
 from typing import List, Optional
@@ -42,14 +59,37 @@ MAX_LEASE_TTL_S = 60.0
 class RegionLog:
     def __init__(self, wal_path: Optional[str] = None):
         self._wal = WriteAheadLog(wal_path)
-        self._records: List[dict] = [rec for rec in self._wal.replay()]
+        self._base = 0  # index of _entries[0] (entries below are compacted)
+        self._entries: List[List[dict]] = []
+        self._snap_index = 0
+        self._snap_state: Optional[dict] = None
+        for rec in self._wal.replay():
+            t = rec.get("t")
+            if t == "__snapshot__":
+                self._snap_index = int(rec["index"])
+                self._snap_state = rec["state"]
+                self._base = int(rec.get("base", self._snap_index))
+                self._entries = []
+            elif t == "__entry__":
+                self._entries.append(list(rec["recs"]))
+            else:
+                # legacy flat record (pre-batch log): singleton entry
+                self._entries.append([rec])
         self._lease_holder: Optional[str] = None
         self._lease_token = 0
         self._lease_expires = 0.0
 
     @property
     def head(self) -> int:
-        return len(self._records)
+        return self._base + len(self._entries)
+
+    @property
+    def base(self) -> int:
+        return self._base
+
+    @property
+    def snapshot_index(self) -> int:
+        return self._snap_index
 
     @property
     def lease_holder(self) -> Optional[str]:
@@ -79,23 +119,63 @@ class RegionLog:
         return True
 
     def append(self, token: int, records: List[dict]) -> Optional[int]:
+        """Append one entry (= one txn's batch) -> its entry index, or
+        None if the lease token is stale/expired (fenced)."""
         if (
             token != self._lease_token
             or self._lease_holder is None
             or time.monotonic() >= self._lease_expires
         ):
             return None  # fenced: stale or expired lease
-        start = len(self._records)
-        for rec in records:
-            self._wal.append(rec)
-            self._records.append(rec)
-        return start
+        idx = self.head
+        self._wal.append({"t": "__entry__", "recs": records})
+        self._entries.append(list(records))
+        return idx
 
     def fetch(self, from_index: int, limit: int = MAX_FETCH):
-        end = min(len(self._records), from_index + limit)
+        """-> list of [entry_index, records] starting at from_index, or
+        None if from_index predates compaction (snapshot required)."""
+        if from_index < self._base:
+            return None
+        lo = from_index - self._base
+        hi = min(len(self._entries), lo + limit)
         return [
-            [i, self._records[i]] for i in range(max(from_index, 0), end)
+            [self._base + i, self._entries[i]] for i in range(lo, hi)
         ]
+
+    def put_snapshot(self, index: int, state: dict):
+        """Accept a state snapshot as of entry `index` and compact
+        entries below it.  Rejects indexes not in (snap_index, head].
+        Returns the records to durably rewrite the WAL with (run the
+        actual file rewrite off the event loop via compact_wal), or
+        None if rejected."""
+        if index <= self._snap_index or index > self.head:
+            return None
+        self._snap_index = index
+        self._snap_state = state
+        drop = index - self._base
+        if drop > 0:
+            self._entries = self._entries[drop:]
+            self._base = index
+        return [
+            {
+                "t": "__snapshot__",
+                "index": self._snap_index,
+                "base": self._base,
+                "state": self._snap_state,
+            }
+        ] + [{"t": "__entry__", "recs": e} for e in self._entries]
+
+    def compact_wal(self, records) -> None:
+        """The blocking file rewrite for put_snapshot's compaction —
+        call from a worker thread; WriteAheadLog's lock serializes it
+        against concurrent appends."""
+        self._wal.rewrite(records)
+
+    def get_snapshot(self):
+        if self._snap_state is None:
+            return None
+        return self._snap_index, self._snap_state
 
     def close(self):
         self._wal.close()
@@ -105,8 +185,12 @@ def build_region_app(
     wal_path: Optional[str] = None, *, auth_token: Optional[str] = None
 ) -> web.Application:
     log = RegionLog(wal_path)
-    app = web.Application()
+    app = web.Application(client_max_size=256 * 1024 * 1024)
     app["region_log"] = log
+    # serializes appends against snapshot compaction's WAL rewrite: an
+    # append interleaving between the rewrite's entry capture and the
+    # file replace would be silently dropped from disk
+    app["snapshot_lock"] = asyncio.Lock()
 
     @web.middleware
     async def auth_middleware(request, handler):
@@ -158,10 +242,11 @@ def build_region_app(
             records = list(body.get("records", []))
         except (ValueError, TypeError, AttributeError):
             return web.json_response({"error": "malformed body"}, status=400)
-        idx = log.append(token, records)
+        async with app["snapshot_lock"]:
+            idx = log.append(token, records)
         if idx is None:
             return web.json_response({"error": "lease fenced"}, status=409)
-        return web.json_response({"from_index": idx})
+        return web.json_response({"index": idx})
 
     async def records(request):
         try:
@@ -171,9 +256,48 @@ def build_region_app(
             return web.json_response(
                 {"error": "malformed from/limit"}, status=400
             )
-        return web.json_response(
-            {"records": log.fetch(frm, limit), "head": log.head}
-        )
+        entries = log.fetch(frm, limit)
+        if entries is None:
+            return web.json_response(
+                {
+                    "snapshot_required": True,
+                    "snapshot_index": log.snapshot_index,
+                },
+                status=409,
+            )
+        return web.json_response({"entries": entries, "head": log.head})
+
+    async def snapshot_put(request):
+        try:
+            body = await request.json()
+            index = int(body["index"])
+            state = body["state"]
+        except (ValueError, TypeError, KeyError, AttributeError):
+            return web.json_response({"error": "malformed body"}, status=400)
+        # mutate log state in-loop (fast); json-serialize + fsync the
+        # compacted WAL in a worker thread so /lease and /append stay
+        # responsive (a stalled loop would expire writers' leases).
+        # The snapshot lock keeps a concurrent snapshot_put from
+        # interleaving its rewrite; appends during the rewrite are
+        # serialized by the WAL's own lock and land after the rename.
+        async with app["snapshot_lock"]:
+            wal_records = log.put_snapshot(index, state)
+            if wal_records is None:
+                return web.json_response(
+                    {"error": "stale or out-of-range snapshot index"},
+                    status=409,
+                )
+            await asyncio.get_running_loop().run_in_executor(
+                None, log.compact_wal, wal_records
+            )
+        return web.json_response({})
+
+    async def snapshot_get(request):
+        snap = log.get_snapshot()
+        if snap is None:
+            return web.json_response({"error": "no snapshot"}, status=404)
+        index, state = snap
+        return web.json_response({"index": index, "state": state})
 
     async def on_cleanup(app):
         log.close()
@@ -184,4 +308,6 @@ def build_region_app(
     app.router.add_delete("/lease", lease_release)
     app.router.add_post("/append", append)
     app.router.add_get("/records", records)
+    app.router.add_post("/snapshot", snapshot_put)
+    app.router.add_get("/snapshot", snapshot_get)
     return app

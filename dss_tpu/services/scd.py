@@ -9,6 +9,7 @@ constraint handlers (constraints_handler.go:12-30).
 
 from __future__ import annotations
 
+import contextlib
 import uuid as uuidlib
 from typing import List, Optional
 
@@ -102,16 +103,15 @@ class SCDService:
             except ValueError as e:
                 raise errors.bad_request(str(e))
 
-        with self.store.transaction():
+        @contextlib.contextmanager
+        def conflict_details():
+            """On MISSING_OVNS, attach the AirspaceConflictResponse
+            payload with the full conflict set
+            (operations_handler.go:268-280)."""
             try:
-                # Validate (incl. the OVN key check) BEFORE journaling
-                # the implicit subscription: a rejected conflict is a
-                # routine outcome and must leave nothing to roll back.
-                self.store.validate_operation_upsert(op, key)
+                yield
             except errors.StatusError as e:
                 if e.code == errors.Code.MISSING_OVNS:
-                    # attach the AirspaceConflictResponse payload with
-                    # the full conflict set (operations_handler.go:268-280)
                     ops = self.store.search_operations(
                         cells,
                         u_extent.spatial_volume.altitude_lo,
@@ -121,6 +121,13 @@ class SCDService:
                     )
                     e.details = _missing_ovns_response(ops)
                 raise
+
+        with self.store.transaction():
+            with conflict_details():
+                # Validate (incl. the OVN key check) BEFORE journaling
+                # the implicit subscription: a rejected conflict is a
+                # routine outcome and must leave nothing to roll back.
+                self.store.validate_operation_upsert(op, key)
 
             if not subscription_id:
                 sub, _ = self.store.upsert_subscription(
@@ -142,19 +149,12 @@ class SCDService:
                 )
                 op.subscription_id = sub.id
 
-            try:
-                stored, subs = self.store.upsert_operation(op, key)
-            except errors.StatusError as e:
-                if e.code == errors.Code.MISSING_OVNS:
-                    ops = self.store.search_operations(
-                        cells,
-                        u_extent.spatial_volume.altitude_lo,
-                        u_extent.spatial_volume.altitude_hi,
-                        u_extent.start_time,
-                        u_extent.end_time,
-                    )
-                    e.details = _missing_ovns_response(ops)
-                raise
+            with conflict_details():
+                # key_checked: the OVN search already ran in this txn
+                # scope (pinned timestamp -> same visibility answers)
+                stored, subs = self.store.upsert_operation(
+                    op, key, key_checked=True
+                )
         return {
             "operation_reference": ser.op_to_json(stored),
             "subscribers": ser.scd_subscribers_to_notify_json(subs),
