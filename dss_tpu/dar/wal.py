@@ -61,7 +61,9 @@ class WriteAheadLog:
     def rewrite(self, records) -> None:
         """Atomically replace the log's contents with `records`
         (compaction): write a temp file, fsync, rename over the old
-        log, reopen for append.  Sequence numbering restarts."""
+        log, reopen for append.  Sequence numbering restarts.  Blocks
+        concurrent appends for the duration — callers who can't afford
+        that should stage a temp file themselves and use adopt()."""
         if self.path is None:
             return
         with self._lock:
@@ -81,6 +83,20 @@ class WriteAheadLog:
             if self._fh is not None:
                 self._fh.close()
             os.replace(tmp, self.path)
+            self._seq = seq
+            self._fh = open(self.path, "a", encoding="utf-8")
+
+    def adopt(self, tmp_path: str, seq: int) -> None:
+        """Swap a fully-written, fsynced replacement log into place:
+        rename over the old log and reopen for append.  The caller
+        guarantees no append races the swap (e.g. by staging the swap
+        on the thread that owns all appends)."""
+        if self.path is None:
+            return
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+            os.replace(tmp_path, self.path)
             self._seq = seq
             self._fh = open(self.path, "a", encoding="utf-8")
 

@@ -84,7 +84,6 @@ class RegionCoordinator:
         self._snapshot_every = max(int(snapshot_every), 1)
         self._applied = 0  # next log ENTRY index to apply
         self._last_snapshot = 0  # entry index of the last snapshot upload
-        self._pending_snapshot: Optional[Tuple[int, dict]] = None
         self._buffer: Optional[List[dict]] = None  # active txn's records
         self._depth = 0  # txn nesting (guarded by lock)
         self._dirty = False  # local state diverged; resync required
@@ -214,7 +213,8 @@ class RegionCoordinator:
                 f"{self._applied}); rolled back, converging via the log"
             )
         self._applied += 1
-        self._maybe_snapshot_locked()
+        # snapshot upload is poller-driven (_maybe_upload_snapshot):
+        # the commit path never pays serialization or HTTP for it
 
     def _rollback_locked(self, buf: List[dict]) -> None:
         """Undo an aborted txn's journaled records in reverse order.
@@ -231,29 +231,25 @@ class RegionCoordinator:
                 self._apply_locked(u)
         self._rollbacks += 1
 
-    def _maybe_snapshot_locked(self) -> None:
-        """Serialize a state snapshot every snapshot_every entries and
-        hand it to the tail poller for upload OUTSIDE the store lock —
-        the commit path only pays the in-memory serialization, never
-        the HTTP round trip.  Best-effort: a failed or rejected upload
-        only delays compaction by one interval."""
-        if self._pending_snapshot is not None:
-            return
+    def _maybe_upload_snapshot(self) -> None:
+        """Poller-driven snapshot: every snapshot_every applied entries,
+        serialize state (under the lock — any consistent applied index
+        is a valid snapshot point) and upload it OUTSIDE the lock, so
+        the user-facing commit path never pays serialization or HTTP
+        for compaction.  Best-effort: a failed or rejected upload only
+        delays compaction by one interval."""
         if self._applied - self._last_snapshot < self._snapshot_every:
             return
-        state = {
-            "rid": self._rid.serialize_state(),
-            "scd": self._scd.serialize_state(),
-        }
-        self._pending_snapshot = (self._applied, state)
-
-    def _upload_pending_snapshot(self) -> None:
-        """Poller-thread side of _maybe_snapshot_locked (no lock held
-        during the upload)."""
-        pend = self._pending_snapshot
-        if pend is None:
-            return
-        idx, state = pend
+        with self._lock:
+            if self._dirty or self.collecting:
+                return  # only snapshot log-consistent state
+            if self._applied - self._last_snapshot < self._snapshot_every:
+                return
+            idx = self._applied
+            state = {
+                "rid": self._rid.serialize_state(),
+                "scd": self._scd.serialize_state(),
+            }
         try:
             if not self._client.put_snapshot(idx, state):
                 log.warning(
@@ -268,9 +264,8 @@ class RegionCoordinator:
         finally:
             with self._lock:
                 # advance even on failure: back off instead of
-                # re-serializing state on every subsequent commit
+                # re-serializing on every poll tick
                 self._last_snapshot = max(self._last_snapshot, idx)
-                self._pending_snapshot = None
 
     # -- apply / resync (store lock held) ------------------------------------
 
@@ -342,16 +337,23 @@ class RegionCoordinator:
         except RegionError:
             self._dirty = True
             raise
-        # network done — swap state locally (no I/O below)
-        self._rid.reset_state()
-        self._scd.reset_state()
-        self._applied = 0
-        if snap is not None:
-            self._restore_snapshot_locked(*snap)
-        for idx, recs in fetched:
-            if idx >= self._applied:
-                self._apply_entry_locked(recs)
-                self._applied = idx + 1
+        # network done — swap state locally (no I/O below).  Any
+        # failure mid-swap (e.g. a corrupt snapshot doc) leaves the
+        # store wiped/partial, so it MUST mark dirty: writes refuse and
+        # the poller keeps retrying the resync.
+        try:
+            self._rid.reset_state()
+            self._scd.reset_state()
+            self._applied = 0
+            if snap is not None:
+                self._restore_snapshot_locked(*snap)
+            for idx, recs in fetched:
+                if idx >= self._applied:
+                    self._apply_entry_locked(recs)
+                    self._applied = idx + 1
+        except Exception:
+            self._dirty = True
+            raise
         self._dirty = False
 
     def _resync_or_mark_dirty(self) -> None:
@@ -368,7 +370,7 @@ class RegionCoordinator:
     def _poll_loop(self) -> None:
         while not self._stop.wait(self._poll_s):
             try:
-                self._upload_pending_snapshot()
+                self._maybe_upload_snapshot()
                 if self._dirty:
                     with self._lock:
                         if self._dirty:

@@ -45,6 +45,8 @@ from __future__ import annotations
 
 import asyncio
 import hmac
+import json
+import os
 import time
 from typing import List, Optional
 
@@ -135,6 +137,7 @@ class RegionLog:
     def fetch(self, from_index: int, limit: int = MAX_FETCH):
         """-> list of [entry_index, records] starting at from_index, or
         None if from_index predates compaction (snapshot required)."""
+        from_index = max(from_index, 0)  # no Python negative indexing
         if from_index < self._base:
             return None
         lo = from_index - self._base
@@ -144,11 +147,16 @@ class RegionLog:
         ]
 
     def put_snapshot(self, index: int, state: dict):
-        """Accept a state snapshot as of entry `index` and compact
-        entries below it.  Rejects indexes not in (snap_index, head].
-        Returns the records to durably rewrite the WAL with (run the
-        actual file rewrite off the event loop via compact_wal), or
-        None if rejected."""
+        """Accept a state snapshot as of entry `index` and compact the
+        in-memory entries below it.  Rejects indexes not in
+        (snap_index, head] and non-dict state (an irreversible compact
+        on a garbage snapshot would brick every future late-join).
+
+        Returns a compaction plan for the durable rewrite (run
+        begin_compact in a worker thread, then finish_compact back on
+        the event-loop thread), or None if rejected."""
+        if not isinstance(state, dict):
+            return None
         if index <= self._snap_index or index > self.head:
             return None
         self._snap_index = index
@@ -157,20 +165,74 @@ class RegionLog:
         if drop > 0:
             self._entries = self._entries[drop:]
             self._base = index
-        return [
-            {
-                "t": "__snapshot__",
-                "index": self._snap_index,
-                "base": self._base,
-                "state": self._snap_state,
-            }
-        ] + [{"t": "__entry__", "recs": e} for e in self._entries]
+        return {
+            "head_records": [
+                {
+                    "t": "__snapshot__",
+                    "index": self._snap_index,
+                    "base": self._base,
+                    "state": self._snap_state,
+                }
+            ]
+            + [{"t": "__entry__", "recs": e} for e in self._entries],
+            "n_entries": len(self._entries),
+        }
 
-    def compact_wal(self, records) -> None:
-        """The blocking file rewrite for put_snapshot's compaction —
-        call from a worker thread; WriteAheadLog's lock serializes it
-        against concurrent appends."""
-        self._wal.rewrite(records)
+    def begin_compact(self, plan) -> Optional[dict]:
+        """Phase 1 (worker thread, NO locks): stream the bulk of the
+        compacted WAL — snapshot + entries captured by put_snapshot —
+        to a temp file and fsync it.  Appends keep landing in the live
+        log meanwhile.  Returns the staging handle."""
+        if self._wal.path is None:
+            return None
+        tmp = f"{self._wal.path}.compact.tmp"
+        seq = 0
+        fh = open(tmp, "w", encoding="utf-8")
+        try:
+            for rec in plan["head_records"]:
+                seq += 1
+                fh.write(
+                    json.dumps(dict(rec, seq=seq), separators=(",", ":"))
+                    + "\n"
+                )
+            fh.flush()
+            os.fsync(fh.fileno())
+        except BaseException:
+            fh.close()
+            os.remove(tmp)
+            raise
+        return {"tmp": tmp, "fh": fh, "seq": seq, "n": plan["n_entries"]}
+
+    def finish_compact(self, staging: Optional[dict]) -> None:
+        """Phase 2 (event-loop thread — the thread that owns ALL
+        appends, so nothing can interleave): append the delta entries
+        that arrived during phase 1, fsync the small tail, and swap the
+        staged file over the live WAL."""
+        if staging is None:
+            return
+        fh, seq = staging["fh"], staging["seq"]
+        try:
+            for e in self._entries[staging["n"]:]:
+                seq += 1
+                fh.write(
+                    json.dumps(
+                        {"t": "__entry__", "recs": e, "seq": seq},
+                        separators=(",", ":"),
+                    )
+                    + "\n"
+                )
+            fh.flush()
+            os.fsync(fh.fileno())
+            fh.close()
+            self._wal.adopt(staging["tmp"], seq)
+        except BaseException:
+            try:
+                fh.close()
+            except Exception:
+                pass
+            if os.path.exists(staging["tmp"]):
+                os.remove(staging["tmp"])
+            raise
 
     def get_snapshot(self):
         if self._snap_state is None:
@@ -187,9 +249,9 @@ def build_region_app(
     log = RegionLog(wal_path)
     app = web.Application(client_max_size=256 * 1024 * 1024)
     app["region_log"] = log
-    # serializes appends against snapshot compaction's WAL rewrite: an
-    # append interleaving between the rewrite's entry capture and the
-    # file replace would be silently dropped from disk
+    # serializes concurrent snapshot_put compactions (appends never
+    # block: the durable swap's finish phase runs on the loop thread,
+    # which owns all appends)
     app["snapshot_lock"] = asyncio.Lock()
 
     @web.middleware
@@ -242,8 +304,7 @@ def build_region_app(
             records = list(body.get("records", []))
         except (ValueError, TypeError, AttributeError):
             return web.json_response({"error": "malformed body"}, status=400)
-        async with app["snapshot_lock"]:
-            idx = log.append(token, records)
+        idx = log.append(token, records)
         if idx is None:
             return web.json_response({"error": "lease fenced"}, status=409)
         return web.json_response({"index": idx})
@@ -274,22 +335,23 @@ def build_region_app(
             state = body["state"]
         except (ValueError, TypeError, KeyError, AttributeError):
             return web.json_response({"error": "malformed body"}, status=400)
-        # mutate log state in-loop (fast); json-serialize + fsync the
-        # compacted WAL in a worker thread so /lease and /append stay
-        # responsive (a stalled loop would expire writers' leases).
-        # The snapshot lock keeps a concurrent snapshot_put from
-        # interleaving its rewrite; appends during the rewrite are
-        # serialized by the WAL's own lock and land after the rename.
+        # Two-phase durable compaction: the bulk write + fsync runs in
+        # a worker thread (the loop keeps serving /lease and /append —
+        # a stalled loop would expire writers' leases); the small
+        # finish (delta entries + rename) runs back on the loop thread,
+        # which owns all appends, so nothing can interleave with the
+        # swap.  The snapshot lock serializes concurrent snapshot_puts.
         async with app["snapshot_lock"]:
-            wal_records = log.put_snapshot(index, state)
-            if wal_records is None:
+            plan = log.put_snapshot(index, state)
+            if plan is None:
                 return web.json_response(
-                    {"error": "stale or out-of-range snapshot index"},
+                    {"error": "stale, out-of-range, or malformed snapshot"},
                     status=409,
                 )
-            await asyncio.get_running_loop().run_in_executor(
-                None, log.compact_wal, wal_records
+            staging = await asyncio.get_running_loop().run_in_executor(
+                None, log.begin_compact, plan
             )
+            log.finish_compact(staging)
         return web.json_response({})
 
     async def snapshot_get(request):

@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# One-command full-stack e2e: launches dummy-oauth, a standalone DSS
+# server (tpu index + WAL), a region log server and two region-joined
+# DSS instances — all as real OS processes — then runs the prober-parity
+# black-box suite against them over real sockets.
+#
+# The analog of the reference's test/docker_e2e.sh:55-131 (build ->
+# CRDB -> grpc-backend -> http-gateway -> dummy-oauth -> prober).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec python -m pytest tests/e2e -q "$@"

@@ -1,0 +1,228 @@
+"""Black-box e2e fixtures: the deployable binaries run as REAL OS
+processes and are driven over real sockets.
+
+The analog of the reference's docker e2e harness
+(test/docker_e2e.sh:55-131): build/launch dummy-oauth + DSS backend,
+wait for health, run the prober suite against the live stack.  Here:
+
+  stack        — dummy_oauth + one standalone DSS server (tpu index)
+  region_stack — dummy_oauth + region log server + TWO DSS instances
+                 joined to it (the two-DSS interoperability shape)
+
+All processes are `python -m dss_tpu.cmds.*` exactly as a deployment
+would run them; nothing is imported in-process.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+import requests
+from cryptography.hazmat.primitives import serialization
+from cryptography.hazmat.primitives.asymmetric import rsa
+
+REPO = Path(__file__).resolve().parents[2]
+AUD = "localhost"
+STARTUP_DEADLINE_S = 60.0
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def wait_healthy(url: str, proc: subprocess.Popen, what: str):
+    deadline = time.monotonic() + STARTUP_DEADLINE_S
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            err = proc.stderr.read().decode(errors="replace")[-4000:]
+            raise RuntimeError(f"{what} exited at startup:\n{err}")
+        try:
+            if requests.get(url, timeout=1).status_code == 200:
+                return
+        except requests.RequestException:
+            pass
+        time.sleep(0.1)
+    raise RuntimeError(f"{what} never became healthy at {url}")
+
+
+class Proc:
+    def __init__(self, argv, what):
+        self.what = what
+        self.p = subprocess.Popen(
+            [sys.executable, "-m", *argv],
+            cwd=REPO,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.PIPE,
+        )
+
+    def stop(self):
+        if self.p.poll() is None:
+            self.p.send_signal(signal.SIGTERM)
+            try:
+                self.p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self.p.kill()
+                self.p.wait(timeout=5)
+
+
+@pytest.fixture(scope="session")
+def certs(tmp_path_factory):
+    d = tmp_path_factory.mktemp("certs")
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    (d / "oauth.key").write_bytes(
+        key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.PKCS8,
+            serialization.NoEncryption(),
+        )
+    )
+    (d / "oauth.pem").write_bytes(
+        key.public_key().public_bytes(
+            serialization.Encoding.PEM,
+            serialization.PublicFormat.SubjectPublicKeyInfo,
+        )
+    )
+    return d
+
+
+class OauthClient:
+    def __init__(self, base):
+        self.base = base
+
+    def token(self, scope, sub="uss1"):
+        r = requests.get(
+            f"{self.base}/token",
+            params={
+                "grant_type": "client_credentials",
+                "scope": scope,
+                "intended_audience": AUD,
+                "issuer": "dummy-oauth",
+                "sub": sub,
+            },
+            timeout=5,
+        )
+        r.raise_for_status()
+        return r.json()["access_token"]
+
+    def hdr(self, scope, sub="uss1"):
+        return {"Authorization": f"Bearer {self.token(scope, sub)}"}
+
+
+@pytest.fixture(scope="session")
+def oauth(certs):
+    port = free_port()
+    p = Proc(
+        [
+            "dss_tpu.cmds.dummy_oauth",
+            "--addr", f":{port}",
+            "--private_key_file", str(certs / "oauth.key"),
+        ],
+        "dummy-oauth",
+    )
+    base = f"http://127.0.0.1:{port}"
+    try:
+        # /token doubles as the health probe (there is no /healthy)
+        deadline = time.monotonic() + STARTUP_DEADLINE_S
+        while True:
+            if p.p.poll() is not None:
+                raise RuntimeError(
+                    "dummy-oauth exited: "
+                    + p.p.stderr.read().decode(errors="replace")[-4000:]
+                )
+            try:
+                r = requests.get(
+                    f"{base}/token", params={"scope": "x"}, timeout=1
+                )
+                if r.status_code == 200:
+                    break
+            except requests.RequestException:
+                pass
+            if time.monotonic() > deadline:
+                raise RuntimeError("dummy-oauth never served /token")
+            time.sleep(0.1)
+        yield OauthClient(base)
+    finally:
+        p.stop()
+
+
+@pytest.fixture(scope="session")
+def stack(certs, oauth, tmp_path_factory):
+    """Standalone DSS: the server binary with the tpu index backend and
+    a real WAL, driven over HTTP."""
+    port = free_port()
+    wal = tmp_path_factory.mktemp("wal") / "dss.wal"
+    p = Proc(
+        [
+            "dss_tpu.cmds.server",
+            "--addr", f":{port}",
+            "--enable_scd",
+            "--storage", "tpu",
+            "--wal_path", str(wal),
+            "--public_key_files", str(certs / "oauth.pem"),
+            "--accepted_jwt_audiences", AUD,
+        ],
+        "dss-server",
+    )
+    base = f"http://127.0.0.1:{port}"
+    try:
+        wait_healthy(f"{base}/healthy", p.p, "dss-server")
+        yield {"base": base, "oauth": oauth, "wal": wal, "proc": p}
+    finally:
+        p.stop()
+
+
+@pytest.fixture(scope="session")
+def region_stack(certs, oauth, tmp_path_factory):
+    """Two DSS instances joined through a region log server — the
+    two-USS interoperability deployment, every piece a real process."""
+    wal = tmp_path_factory.mktemp("regionwal") / "region.wal"
+    log_port = free_port()
+    log_proc = Proc(
+        [
+            "dss_tpu.cmds.region_server",
+            "--addr", f":{log_port}",
+            "--wal_path", str(wal),
+        ],
+        "region-server",
+    )
+    log_base = f"http://127.0.0.1:{log_port}"
+    instances = []
+    try:
+        wait_healthy(f"{log_base}/healthy", log_proc.p, "region-server")
+        bases = []
+        for i in range(2):
+            port = free_port()
+            p = Proc(
+                [
+                    "dss_tpu.cmds.server",
+                    "--addr", f":{port}",
+                    "--enable_scd",
+                    "--storage", "memory",
+                    "--region_url", log_base,
+                    "--region_poll_interval", "0.02",
+                    "--instance_id", f"e2e-dss-{i}",
+                    "--public_key_files", str(certs / "oauth.pem"),
+                    "--accepted_jwt_audiences", AUD,
+                ],
+                f"dss-{i}",
+            )
+            instances.append(p)
+            bases.append(f"http://127.0.0.1:{port}")
+        for i, b in enumerate(bases):
+            wait_healthy(f"{b}/healthy", instances[i].p, f"dss-{i}")
+        yield {"bases": bases, "oauth": oauth, "log_base": log_base}
+    finally:
+        for p in instances:
+            p.stop()
+        log_proc.stop()

@@ -592,11 +592,9 @@ def test_snapshot_compaction_bounds_late_join(region):
         deadline_s=30,
     )
     store.region._snapshot_every = 1  # due for a snapshot immediately
-    with store._lock:
-        store.region._maybe_snapshot_locked()
-    # the tail poller uploads the captured snapshot off-lock
+    # the tail poller serializes + uploads the snapshot off the write path
     wait_until(
-        lambda: store.region._last_snapshot == store.region.applied or None,
+        lambda: store.region._last_snapshot >= store.region.applied or None,
         deadline_s=30,
     )
     with pytest.raises(SnapshotRequired):
