@@ -34,7 +34,6 @@ from dss_tpu.geo.s2cell import (
     cell_corners,
     cell_id_from_point,
     cell_level,
-    cell_neighbors8,
     latlng_to_xyz,
     st_to_uv,
     uv_to_st,
@@ -278,33 +277,165 @@ def _segment_intersects_cell(a, b, cell_id) -> bool:
 
 
 # ---------------------------------------------------------------------------
+# Vectorized predicates (batch over candidate cells)
+# ---------------------------------------------------------------------------
+
+
+def _arcs_cross_many(a, b, c, d):
+    """Vectorized _edges_cross: arcs A[k]->B[k] vs C[j]->D[j] for every
+    (k, j) pair -> bool (K, J).  Same math and strict inequalities as
+    the scalar version (identical verdicts)."""
+    a = np.atleast_2d(a)
+    b = np.atleast_2d(b)
+    c = np.atleast_2d(c)
+    d = np.atleast_2d(d)
+    n1 = np.cross(a, b)  # (K, 3)
+    n2 = np.cross(c, d)  # (J, 3)
+    x = np.cross(n1[:, None, :], n2[None, :, :])  # (K, J, 3)
+    norm = np.linalg.norm(x, axis=-1)
+    ok = norm >= 1e-30
+    with np.errstate(divide="ignore", invalid="ignore"):
+        x = x / np.where(norm[..., None] == 0.0, 1.0, norm[..., None])
+    dab = np.sum(a * b, axis=-1)  # (K,)
+    dcd = np.sum(c * d, axis=-1)  # (J,)
+    out = np.zeros(ok.shape, dtype=bool)
+    for s in (1.0, -1.0):
+        p = s * x  # (K, J, 3)
+        out |= (
+            (np.sum(p * a[:, None, :], axis=-1) > dab[:, None])
+            & (np.sum(p * b[:, None, :], axis=-1) > dab[:, None])
+            & (np.sum(p * c[None, :, :], axis=-1) > dcd[None, :])
+            & (np.sum(p * d[None, :, :], axis=-1) > dcd[None, :])
+        )
+    return out & ok
+
+
+def _points_in_loop(loop: Loop, pts) -> np.ndarray:
+    """Vectorized Loop.contains for (P, 3) points -> bool (P,).
+
+    Points exactly equal to a loop vertex (or the parity origin) need
+    the vertex-crossing tie-break — those few fall back to the scalar
+    path; everything else is one batched crossing-parity computation."""
+    pts = np.atleast_2d(np.asarray(pts, dtype=np.float64))
+    P = len(pts)
+    if P == 0:
+        return np.zeros(0, dtype=bool)
+    edges_a = loop.v
+    edges_b = np.roll(loop.v, -1, axis=0)
+    o = loop._origin
+    # exact endpoint sharing -> scalar tie-break path
+    shared = (
+        np.all(pts[:, None, :] == loop.v[None, :, :], axis=-1).any(axis=1)
+        | np.all(pts == o, axis=-1)
+    )
+    arcs_a = np.broadcast_to(o, pts.shape)
+    cross = _arcs_cross_many(arcs_a, pts, edges_a, edges_b)  # (P, N)
+    parity = (np.sum(cross, axis=1) & 1).astype(bool)
+    inside = parity != loop._origin_inside
+    if shared.any():
+        for k in np.flatnonzero(shared):
+            inside[k] = loop.contains(pts[k])
+    return inside
+
+
+def _cells_intersect_loop(cids, loop: Loop, loop_vertex_cells) -> np.ndarray:
+    """Vectorized _cell_intersects_loop over (M,) cell ids -> bool (M,)."""
+    cids = np.asarray(cids, dtype=np.uint64)
+    M = len(cids)
+    if M == 0:
+        return np.zeros(0, dtype=bool)
+    corners = s2cell.cell_corners(cids)  # (M, 4, 3)
+    # (a) any corner inside the loop
+    hit = _points_in_loop(loop, corners.reshape(-1, 3)).reshape(M, 4).any(axis=1)
+    # (b) cell contains a loop vertex (by vertex-cell id)
+    if loop_vertex_cells:
+        vc = np.fromiter(loop_vertex_cells, dtype=np.uint64,
+                         count=len(loop_vertex_cells))
+        hit |= np.isin(cids, vc)
+    # (c) any loop vertex projects inside the cell's face-uv rect
+    face, u_lo, u_hi, v_lo, v_hi = s2cell.cell_uv_bounds(cids)
+    pf, pu, pv = xyz_to_face_uv(loop.v)  # (N,)
+    in_rect = (
+        (np.asarray(face)[:, None] == pf[None, :])
+        & (np.asarray(u_lo)[:, None] <= pu[None, :])
+        & (pu[None, :] <= np.asarray(u_hi)[:, None])
+        & (np.asarray(v_lo)[:, None] <= pv[None, :])
+        & (pv[None, :] <= np.asarray(v_hi)[:, None])
+    )
+    hit |= in_rect.any(axis=1)
+    # (d) any loop edge crosses any cell edge
+    todo = ~hit
+    if todo.any():
+        sub = corners[todo]  # (S, 4, 3)
+        ca = sub.reshape(-1, 3)  # cell edge starts
+        cb = np.roll(sub, -1, axis=1).reshape(-1, 3)  # cell edge ends
+        ea = loop.v
+        eb = np.roll(loop.v, -1, axis=0)
+        cross = _arcs_cross_many(ca, cb, ea, eb)  # (S*4, N)
+        hit[todo] = cross.reshape(-1, 4, loop.n).any(axis=(1, 2))
+    return hit
+
+
+def _cells_intersect_segment(cids, a, b) -> np.ndarray:
+    """Vectorized _segment_intersects_cell over (M,) cells."""
+    cids = np.asarray(cids, dtype=np.uint64)
+    M = len(cids)
+    if M == 0:
+        return np.zeros(0, dtype=bool)
+    face, u_lo, u_hi, v_lo, v_hi = s2cell.cell_uv_bounds(cids)
+    ends = np.stack([a, b])  # (2, 3)
+    pf, pu, pv = xyz_to_face_uv(ends)
+    in_rect = (
+        (np.asarray(face)[:, None] == pf[None, :])
+        & (np.asarray(u_lo)[:, None] <= pu[None, :])
+        & (pu[None, :] <= np.asarray(u_hi)[:, None])
+        & (np.asarray(v_lo)[:, None] <= pv[None, :])
+        & (pv[None, :] <= np.asarray(v_hi)[:, None])
+    )
+    hit = in_rect.any(axis=1)
+    todo = ~hit
+    if todo.any():
+        corners = s2cell.cell_corners(cids[todo])  # (S, 4, 3)
+        ca = corners.reshape(-1, 3)
+        cb = np.roll(corners, -1, axis=1).reshape(-1, 3)
+        cross = _arcs_cross_many(ca, cb, a[None, :], b[None, :])
+        hit[todo] = cross.reshape(-1, 4).any(axis=1)
+    return hit
+
+
+# ---------------------------------------------------------------------------
 # Coverings
 # ---------------------------------------------------------------------------
 
 
-def _flood_fill(seeds, predicate):
-    """BFS over the level-13 grid from seed cells, keeping cells where
-    predicate(cell) holds; returns a sorted uint64 array."""
-    result = set()
-    frontier = []
-    seen = set()
-    for s in seeds:
-        si = int(np.uint64(s))
-        if si not in seen:
-            seen.add(si)
-            frontier.append(np.uint64(s))
-    while frontier:
-        cid = frontier.pop()
-        if predicate(cid):
-            result.add(int(np.uint64(cid)))
-            if len(result) > _MAX_COVERING_CELLS:
+def _flood_fill(seeds, batch_predicate):
+    """Wave BFS over the level-13 grid from seed cells: each wave of
+    candidate cells is tested by ONE vectorized batch_predicate call,
+    and the kept cells' 8-neighborhoods form the next wave.  Returns a
+    sorted uint64 array."""
+    wave = np.unique(np.asarray(list(seeds), dtype=np.uint64))
+    seen = set(int(c) for c in wave)
+    result = []
+    n_result = 0
+    while wave.size:
+        keep = batch_predicate(wave)
+        kept = wave[keep]
+        if kept.size:
+            result.append(kept)
+            n_result += kept.size
+            if n_result > _MAX_COVERING_CELLS:
                 raise AreaTooLargeError("covering exceeds maximum cell count")
-            for nb in cell_neighbors8(cid):
-                ni = int(np.uint64(nb))
-                if ni not in seen:
-                    seen.add(ni)
-                    frontier.append(nb)
-    return np.sort(np.array(sorted(result), dtype=np.uint64))
+            nbrs = np.unique(
+                s2cell.cell_neighbors8_many(kept).ravel()
+            )
+            fresh = [int(c) for c in nbrs if int(c) not in seen]
+            seen.update(fresh)
+            wave = np.array(fresh, dtype=np.uint64)
+        else:
+            wave = np.array([], dtype=np.uint64)
+    if not result:
+        return np.array([], dtype=np.uint64)
+    return np.sort(np.concatenate(result))
 
 
 def covering_polyline(points_xyz) -> np.ndarray:
@@ -320,7 +451,9 @@ def covering_polyline(points_xyz) -> np.ndarray:
             cell_id_from_point(a, level=DAR_LEVEL),
             cell_id_from_point(b, level=DAR_LEVEL),
         ]
-        cells = _flood_fill(seeds, lambda cid: _segment_intersects_cell(a, b, cid))
+        cells = _flood_fill(
+            seeds, lambda wave: _cells_intersect_segment(wave, a, b)
+        )
         result.update(int(c) for c in cells)
     return np.sort(np.array(sorted(result), dtype=np.uint64))
 
@@ -332,7 +465,8 @@ def _loop_covering(loop: Loop) -> np.ndarray:
     }
     seeds = [np.uint64(c) for c in loop_vertex_cells]
     return _flood_fill(
-        seeds, lambda cid: _cell_intersects_loop(cid, loop, loop_vertex_cells)
+        seeds,
+        lambda wave: _cells_intersect_loop(wave, loop, loop_vertex_cells),
     )
 
 

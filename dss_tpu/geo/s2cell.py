@@ -232,9 +232,13 @@ def cell_level(cell_id):
 
 
 def cell_parent(cell_id, level):
-    """Parent of cell id(s) at 'level' (must be <= current level)."""
+    """Parent of cell id(s) at 'level' (must be <= current level).
+    `level` may be a scalar or an array broadcastable against cell_id."""
     cid = np.asarray(cell_id, dtype=np.uint64)
-    new_lsb = np.uint64(1) << np.uint64(2 * (MAX_LEVEL - level))
+    shift = (
+        2 * (MAX_LEVEL - np.asarray(level, dtype=np.int64))
+    ).astype(np.uint64)
+    new_lsb = np.uint64(1) << shift
     neg = (~new_lsb) + np.uint64(1)  # two's complement of new_lsb
     return (cid & neg) | new_lsb
 
@@ -337,6 +341,36 @@ def cell_neighbors8(cell_id):
             seen.add(ci)
             uniq.append(c)
     return uniq
+
+
+def cell_neighbors8_many(cell_ids):
+    """All 8 same-level neighbors of each cell id, vectorized: (M, 8)
+    uint64 (duplicates possible at face corners; callers np.unique).
+    Each neighbor is produced at its input cell's own level (like the
+    scalar cell_neighbors8).
+
+    Uniform path for in-face and cross-face steps: the would-be
+    neighbor's center (i, j) maps through st->uv->xyz (st_to_uv
+    extrapolates monotonically beyond [0, 1], landing the point on the
+    adjacent face) and back through cell_id_from_point."""
+    cids = np.asarray(cell_ids, dtype=np.uint64)
+    level = cell_level(cids)  # (M,)
+    face, i_lo, j_lo, size = cell_ij_bounds(cids)
+    scale = 1.0 / (1 << MAX_LEVEL)
+    offs = np.array(
+        [(di, dj) for di in (-1, 0, 1) for dj in (-1, 0, 1)
+         if not (di == 0 and dj == 0)],
+        dtype=np.int64,
+    )  # (8, 2)
+    s = (i_lo[..., None] + offs[None, :, 0] * size[..., None]
+         + size[..., None] / 2.0) * scale
+    t = (j_lo[..., None] + offs[None, :, 1] * size[..., None]
+         + size[..., None] / 2.0) * scale
+    u = st_to_uv(s)
+    v = st_to_uv(t)
+    f = np.broadcast_to(np.asarray(face)[..., None], u.shape)
+    p = face_uv_to_xyz(f, u, v)  # (M, 8, 3)
+    return cell_id_from_point(p, level=np.asarray(level)[..., None])
 
 
 def cell_token(cell_id):
