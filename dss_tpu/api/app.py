@@ -101,6 +101,31 @@ async def error_middleware(request, handler):
         return _error_response(errors.internal(str(e)))
 
 
+def make_timeout_middleware(timeout_s: float):
+    """Per-request deadline (the reference's 10 s default RPC timeout,
+    cmds/grpc-backend/main.go:48): a handler that exceeds it gets a 504
+    DEADLINE_EXCEEDED and releases the connection.  The abandoned
+    executor call keeps running to completion in its worker thread
+    (same abandonment semantics as a Go ctx deadline firing while the
+    SQL round trip is in flight); /healthy is exempt so orchestration
+    probes never queue behind a wedged store."""
+
+    @web.middleware
+    async def timeout_middleware(request, handler):
+        if request.path == "/healthy":
+            return await handler(request)
+        try:
+            return await asyncio.wait_for(handler(request), timeout_s)
+        except asyncio.TimeoutError:
+            return _error_response(
+                errors.deadline_exceeded(
+                    f"request exceeded the {timeout_s:g}s deadline"
+                )
+            )
+
+    return timeout_middleware
+
+
 async def _call(fn, *args):
     """Run a synchronous service call off the event loop.  The service
     layer holds the store lock and may run multi-ms TPU kernels (first
@@ -133,13 +158,16 @@ def build_app(
     metrics=None,
     dump_requests: bool = False,
     stats_fn=None,
+    default_timeout_s: float = 10.0,
 ) -> web.Application:
     from dss_tpu.obs.logging import make_access_log_middleware
 
     middlewares = [
         make_access_log_middleware(metrics, dump_requests=dump_requests),
-        error_middleware,
     ]
+    if default_timeout_s and default_timeout_s > 0:
+        middlewares.append(make_timeout_middleware(default_timeout_s))
+    middlewares.append(error_middleware)
     app = web.Application(middlewares=middlewares)
 
     def auth(request, operation: str) -> str:

@@ -13,6 +13,8 @@ from __future__ import annotations
 
 import argparse
 import os
+import threading
+import time
 
 from aiohttp import web
 
@@ -102,6 +104,27 @@ def make_parser() -> argparse.ArgumentParser:
         default="",
         help="stable identity of this DSS instance within the region",
     )
+    p.add_argument(
+        "--no_warmup",
+        action="store_true",
+        help="skip the background fused-kernel compile at startup",
+    )
+    p.add_argument(
+        "--default_timeout",
+        type=float,
+        default=10.0,
+        help="per-request deadline in seconds; exceeding it returns 504 "
+        "(reference: 10s default RPC timeout, grpc-backend main.go:48). "
+        "0 disables.",
+    )
+    p.add_argument(
+        "--shutdown_grace",
+        type=float,
+        default=25.0,
+        help="seconds SIGTERM waits for in-flight requests to complete "
+        "before closing connections (reference: GracefulStop, "
+        "grpc-backend main.go:217-221)",
+    )
     return p
 
 
@@ -136,6 +159,26 @@ def build(args) -> web.Application:
     )
     rid = RIDService(store.rid, clock)
     scd = SCDService(store.scd, clock) if args.enable_scd else None
+
+    if args.storage == "tpu" and not args.no_warmup:
+        # compile the fused kernel's point-lookup executable in the
+        # background so the first real request after boot doesn't burn
+        # its 10 s deadline on the XLA compile (an early request still
+        # waits on the same in-flight compile — never a double compile)
+        from dss_tpu.ops.fastpath import warmup as _fastpath_warmup
+
+        def _warm():
+            try:
+                t0 = time.perf_counter()
+                _fastpath_warmup()
+                log.info(
+                    "fastpath warmup done in %.1fs",
+                    time.perf_counter() - t0,
+                )
+            except Exception:  # noqa: BLE001 — warmup is best-effort
+                log.exception("fastpath warmup failed")
+
+        threading.Thread(target=_warm, name="fastpath-warmup", daemon=True).start()
 
     authorizer = None
     if not args.insecure_no_auth:
@@ -178,6 +221,7 @@ def build(args) -> web.Application:
         metrics=metrics,
         dump_requests=args.dump_requests,
         stats_fn=store.stats,
+        default_timeout_s=args.default_timeout,
     )
 
 
@@ -185,7 +229,15 @@ def main():
     args = make_parser().parse_args()
     app = build(args)
     host, _, port = args.addr.rpartition(":")
-    web.run_app(app, host=host or "0.0.0.0", port=int(port))
+    # run_app installs SIGINT/SIGTERM handlers: the listener stops
+    # accepting, in-flight requests get shutdown_timeout to finish,
+    # then connections close (the GracefulStop analog)
+    web.run_app(
+        app,
+        host=host or "0.0.0.0",
+        port=int(port),
+        shutdown_timeout=args.shutdown_grace,
+    )
 
 
 if __name__ == "__main__":

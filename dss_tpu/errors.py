@@ -127,6 +127,10 @@ def unavailable(msg: str) -> StatusError:
     return StatusError(Code.UNAVAILABLE, msg)
 
 
+def deadline_exceeded(msg: str) -> StatusError:
+    return StatusError(Code.DEADLINE_EXCEEDED, msg)
+
+
 def area_too_large(msg: str) -> StatusError:
     return StatusError(Code.AREA_TOO_LARGE, msg)
 

@@ -14,6 +14,7 @@ import json
 import logging
 import os
 import sys
+import asyncio
 import time
 from typing import Optional
 
@@ -92,7 +93,14 @@ def make_access_log_middleware(metrics=None, dump_requests: bool = False):
             # memory; truncated again to 4096 chars at log time below
             cl = request.content_length
             if cl is not None and cl <= 65536:
-                body = await request.text()
+                # bounded in TIME too: this read happens outside the
+                # per-request deadline middleware, so a client
+                # trickling a declared-length body must not hold the
+                # connection forever
+                try:
+                    body = await asyncio.wait_for(request.text(), 5.0)
+                except asyncio.TimeoutError:
+                    body = "(body read timed out)"
             elif cl is None:
                 body = "(body of undeclared length not dumped)"
             else:

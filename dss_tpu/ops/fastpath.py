@@ -101,6 +101,43 @@ def _bitpack_weights() -> np.ndarray:
     return w
 
 
+def warmup(device=None) -> None:
+    """Compile the fused kernel's small-query executable ahead of
+    traffic: a tiny table + one single-row query exercises exactly the
+    static shapes a serving-path point lookup uses (batch bucket 16,
+    window bucket 256, word bucket 2^16), so the first real request
+    after boot doesn't pay the multi-second XLA compile against its
+    deadline.  Servers call this from a background thread at startup."""
+    n = BLOCK
+    keys = np.arange(n, dtype=np.int32)
+    ft = FastTable(
+        keys,
+        np.arange(n, dtype=np.int32),
+        np.zeros(n, np.float32),
+        np.ones(n, np.float32),
+        np.zeros(n, np.int64),
+        np.full(n, 2, np.int64),
+        np.ones(n, bool),
+        slot_exact=dict(
+            alt_lo=np.zeros(n, np.float32),
+            alt_hi=np.ones(n, np.float32),
+            t0=np.zeros(n, np.int64),
+            t1=np.full(n, 2, np.int64),
+            live=np.ones(n, bool),
+        ),
+        device=device,
+    )
+    qk = np.arange(8, dtype=np.int32)[None, :]
+    ft.query_fused(
+        qk,
+        np.zeros(1, np.float32),
+        np.ones(1, np.float32),
+        np.zeros(1, np.int64),
+        np.ones(1, np.int64),
+        now=1,
+    )
+
+
 class PendingBatch:
     """In-flight fused query batch: device future + host decode state.
 

@@ -1,0 +1,138 @@
+"""Request deadlines + graceful drain (VERDICT r3 #9; reference:
+10 s default RPC timeout cmds/grpc-backend/main.go:48, GracefulStop
+main.go:217-221)."""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+import time
+
+import pytest
+import requests
+from aiohttp import web
+
+from dss_tpu import errors
+from dss_tpu.api.app import build_app
+
+
+class SlowRID:
+    """Service stub whose create hangs longer than the deadline."""
+
+    def __init__(self, delay_s):
+        self.delay_s = delay_s
+        self.completed = []
+
+    def create_isa(self, id, params, owner):
+        time.sleep(self.delay_s)
+        self.completed.append(id)
+        return {"service_area": {"id": id}, "subscribers": []}
+
+    def get_isa(self, id, owner=None):
+        return {"service_area": {"id": id}}
+
+
+class LiveServer:
+    def __init__(self, app: web.Application, shutdown_timeout=25.0):
+        self.app = app
+        self.loop = asyncio.new_event_loop()
+        self.port = None
+        self.shutdown_timeout = shutdown_timeout
+        self._started = threading.Event()
+        self._runner = None
+        self.thread = threading.Thread(target=self._run, daemon=True)
+        self.thread.start()
+        assert self._started.wait(30)
+        self.base = f"http://127.0.0.1:{self.port}"
+
+    def _run(self):
+        asyncio.set_event_loop(self.loop)
+        self._runner = web.AppRunner(
+            self.app, shutdown_timeout=self.shutdown_timeout
+        )
+        self.loop.run_until_complete(self._runner.setup())
+        site = web.TCPSite(self._runner, "127.0.0.1", 0)
+        self.loop.run_until_complete(site.start())
+        self.port = site._server.sockets[0].getsockname()[1]
+        self._started.set()
+        self.loop.run_forever()
+
+    def drain(self):
+        """The SIGTERM path: stop accepting, wait for in-flight
+        requests (up to shutdown_timeout), close."""
+        fut = asyncio.run_coroutine_threadsafe(
+            self._runner.cleanup(), self.loop
+        )
+        fut.result(timeout=self.shutdown_timeout + 10)
+
+    def stop(self):
+        self.loop.call_soon_threadsafe(self.loop.stop)
+        self.thread.join(timeout=10)
+
+
+def test_hung_handler_times_out_504():
+    rid = SlowRID(delay_s=5.0)
+    srv = LiveServer(build_app(rid, None, None, default_timeout_s=0.3))
+    try:
+        t0 = time.perf_counter()
+        r = requests.put(
+            f"{srv.base}/v1/dss/identification_service_areas/x",
+            json={},
+            timeout=10,
+        )
+        dt = time.perf_counter() - t0
+        assert r.status_code == 504, r.text
+        assert r.json()["code"] == int(errors.Code.DEADLINE_EXCEEDED)
+        assert dt < 2.0, f"504 took {dt:.1f}s — deadline not enforced"
+        # a fast request on the same server still works (the wedged
+        # executor call did not take the loop down)
+        assert (
+            requests.get(
+                f"{srv.base}/v1/dss/identification_service_areas/x",
+                timeout=5,
+            ).status_code
+            == 200
+        )
+    finally:
+        srv.stop()
+
+
+def test_healthy_exempt_from_deadline():
+    rid = SlowRID(delay_s=5.0)
+    srv = LiveServer(build_app(rid, None, None, default_timeout_s=0.3))
+    try:
+        assert requests.get(f"{srv.base}/healthy", timeout=5).status_code == 200
+    finally:
+        srv.stop()
+
+
+def test_graceful_drain_completes_inflight():
+    """A request in flight when shutdown starts completes with 200;
+    new connections are refused after the listener stops."""
+    rid = SlowRID(delay_s=1.0)
+    srv = LiveServer(
+        build_app(rid, None, None, default_timeout_s=10.0),
+        shutdown_timeout=10.0,
+    )
+    results = {}
+
+    def client():
+        results["resp"] = requests.put(
+            f"{srv.base}/v1/dss/identification_service_areas/inflight",
+            json={},
+            timeout=15,
+        )
+
+    th = threading.Thread(target=client)
+    th.start()
+    time.sleep(0.3)  # request is now in the slow handler
+    srv.drain()
+    th.join(timeout=15)
+    try:
+        assert results["resp"].status_code == 200, results["resp"].text
+        assert rid.completed == ["inflight"]
+        # the drained server no longer accepts connections
+        with pytest.raises(requests.RequestException):
+            requests.get(f"{srv.base}/healthy", timeout=2)
+    finally:
+        srv.stop()
