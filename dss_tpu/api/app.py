@@ -74,6 +74,7 @@ SCD_SCOPES = {
         SCD_SC, SCD_CC, SCD_CM
     ),
     _SCD + "MakeDssReport": require_any_scope(SCD_SC, SCD_CC, SCD_CM),
+    _AUX + "ReplicaSearchOperations": require_any_scope(SCD_SC),
 }
 
 
@@ -159,6 +160,7 @@ def build_app(
     dump_requests: bool = False,
     stats_fn=None,
     default_timeout_s: float = 10.0,
+    replica=None,  # ShardedOpReplica: multi-chip read-replica surface
 ) -> web.Application:
     from dss_tpu.obs.logging import make_access_log_middleware
 
@@ -216,6 +218,69 @@ def build_app(
         return web.json_response({})
 
     app.router.add_get("/aux/v1/validate_oauth", validate_oauth)
+
+    if replica is not None:
+        # the multi-chip read-replica surface (SURVEY §7 step 7): area
+        # searches served from the ShardedDar snapshot the replica
+        # tails out of the WAL / region log
+        import time as _time
+
+        from dss_tpu.geo import covering as geo_covering
+        from dss_tpu.geo import s2cell as _s2
+        from dss_tpu.services import serialization as _ser
+
+        def _now_ns_fn():
+            return int(_time.time() * 1e9)
+
+        async def replica_search_ops(request):
+            auth(request, _AUX + "ReplicaSearchOperations")
+            area = request.query.get("area", "")
+            try:
+                cells = geo_covering.area_to_cell_ids(area)
+            except geo_covering.AreaTooLargeError as e:
+                raise errors.area_too_large(str(e))
+            except geo_covering.BadAreaError as e:
+                raise errors.bad_request(str(e))
+            keys = _s2.cell_to_dar_key(cells)
+
+            def parse_t(name):
+                raw = request.query.get(name, "")
+                if not raw:
+                    return None
+                from dss_tpu.clock import to_nanos
+
+                try:
+                    return to_nanos(_ser.parse_time(raw))
+                except (ValueError, TypeError) as e:
+                    raise errors.bad_request(f"bad {name}: {e}")
+
+            def parse_f(name):
+                raw = request.query.get(name, "")
+                if not raw:
+                    return None
+                try:
+                    return float(raw)
+                except ValueError:
+                    raise errors.bad_request(f"bad {name}: {raw!r}")
+
+            ids = await _call(
+                functools.partial(
+                    replica.query,
+                    keys,
+                    parse_f("altitude_lo"),
+                    parse_f("altitude_hi"),
+                    parse_t("earliest_time"),
+                    parse_t("latest_time"),
+                    now=_now_ns_fn(),
+                )
+            )
+            return web.json_response(
+                {"operation_ids": ids, "replica": replica.stats()}
+            )
+
+        app.router.add_get(
+            "/aux/v1/replica/operations", replica_search_ops
+        )
 
     # -- RID -----------------------------------------------------------------
 

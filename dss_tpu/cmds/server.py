@@ -105,6 +105,27 @@ def make_parser() -> argparse.ArgumentParser:
         help="stable identity of this DSS instance within the region",
     )
     p.add_argument(
+        "--virtual_cpu_devices",
+        type=int,
+        default=0,
+        help="force an N-virtual-device CPU backend (testing the "
+        "multi-chip path without chips; the driver's dryrun analog)",
+    )
+    p.add_argument(
+        "--sharded_replica",
+        default="",
+        help="'dp,sp' mesh shape: serve a multi-chip ShardedDar read "
+        "replica of SCD operations, refreshed from the WAL (standalone) "
+        "or region log tail, at /aux/v1/replica/operations "
+        "(SURVEY §7 step 7)",
+    )
+    p.add_argument(
+        "--replica_refresh_interval",
+        type=float,
+        default=0.5,
+        help="seconds between replica log polls / snapshot rebuilds",
+    )
+    p.add_argument(
         "--no_warmup",
         action="store_true",
         help="skip the background fused-kernel compile at startup",
@@ -134,6 +155,18 @@ def build(args) -> web.Application:
 
     configure_logging()
     log = get_logger("dss.server")
+    if args.virtual_cpu_devices:
+        # must land before the first backend initialization; config
+        # update (not env) because the environment may force-rewrite
+        # JAX_PLATFORMS (see tests/conftest.py)
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count="
+            f"{args.virtual_cpu_devices}"
+        ).strip()
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
     clock = Clock()
     region_token = os.environ.get("DSS_REGION_TOKEN", "")
     if not region_token and args.region_token_file:
@@ -213,6 +246,60 @@ def build(args) -> web.Application:
 
     metrics = MetricsRegistry()
 
+    replica = None
+    if args.sharded_replica:
+        import jax
+        import numpy as _np
+
+        from dss_tpu.parallel.replica import ShardedOpReplica
+        from jax.sharding import Mesh
+
+        try:
+            dp, sp = (int(x) for x in args.sharded_replica.split(","))
+        except ValueError:
+            raise SystemExit(
+                f"--sharded_replica must be 'dp,sp' (got "
+                f"{args.sharded_replica!r})"
+            )
+        devs = jax.devices()
+        if len(devs) < dp * sp:
+            raise SystemExit(
+                f"--sharded_replica {dp},{sp} needs {dp * sp} devices, "
+                f"have {len(devs)}"
+            )
+        mesh = Mesh(
+            _np.array(devs[: dp * sp]).reshape(dp, sp), ("dp", "sp")
+        )
+        if args.region_url:
+            from dss_tpu.region.client import RegionClient
+
+            replica = ShardedOpReplica(
+                mesh,
+                region_client=RegionClient(
+                    args.region_url,
+                    (args.instance_id or "dss") + "-replica",
+                    auth_token=region_token or None,
+                ),
+            )
+        elif args.wal_path:
+            replica = ShardedOpReplica(mesh, wal_path=args.wal_path)
+        else:
+            raise SystemExit(
+                "--sharded_replica needs --wal_path or --region_url "
+                "(a log to tail)"
+            )
+        replica.start(args.replica_refresh_interval)
+        log.info(
+            "sharded replica serving on a %dx%d mesh (%s)",
+            dp, sp, "region log" if args.region_url else "wal",
+        )
+
+    def stats_fn():
+        out = store.stats()
+        if replica is not None:
+            out.update(replica.stats())
+        return out
+
     return build_app(
         rid,
         scd,
@@ -220,8 +307,9 @@ def build(args) -> web.Application:
         enable_scd=args.enable_scd,
         metrics=metrics,
         dump_requests=args.dump_requests,
-        stats_fn=store.stats,
+        stats_fn=stats_fn,
         default_timeout_s=args.default_timeout,
+        replica=replica,
     )
 
 

@@ -1,0 +1,299 @@
+"""ShardedDar refresh: tail a durable log into a serving multi-chip
+read replica.
+
+SURVEY §7 step 7 (second half): writes land in the single-chip store +
+WAL (or the region log in region mode); this replica tails that log and
+periodically folds it into a fresh `ShardedDar` snapshot on the device
+mesh, swapping it in atomically for readers — the same
+source-of-truth/read-replica split the reference gets from CRDB ranges
+(implementation_details.md:11-42).
+
+Consistency: readers grab ONE (dar, ids) snapshot reference per query,
+so a query always runs against a complete snapshot — concurrent
+refreshes are invisible until their atomic swap.  Staleness is bounded
+by the poll interval + rebuild time.
+
+Sources:
+  - `wal_path`: tail a standalone server's WriteAheadLog file
+    (incremental: remembers the byte offset, only consumes whole
+    lines, tolerates a torn tail write until the next poll);
+  - `region_client`: fetch entries from a region log server.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from dss_tpu.dar import codec
+from dss_tpu.dar.oracle import Record
+from dss_tpu.geo import s2cell
+from dss_tpu.ops.conflict import NO_TIME_HI, NO_TIME_LO
+from dss_tpu.parallel.sharded import ShardedDar
+
+
+class _WalTail:
+    """Incremental reader of a WriteAheadLog file (JSON lines)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._offset = 0
+
+    def poll(self) -> List[dict]:
+        if not os.path.exists(self.path):
+            return []
+        out = []
+        with open(self.path, "r", encoding="utf-8") as fh:
+            fh.seek(self._offset)
+            while True:
+                pos = fh.tell()
+                line = fh.readline()
+                if not line:
+                    break
+                if not line.endswith("\n"):
+                    # torn tail write: re-read from here next poll
+                    fh.seek(pos)
+                    break
+                line = line.strip()
+                if not line:
+                    self._offset = fh.tell()
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    # torn write that still got a newline: stop here
+                    # and retry next poll
+                    fh.seek(pos)
+                    break
+                self._offset = fh.tell()
+        return out
+
+
+class _RegionTail:
+    """Incremental reader of a region log (batch entries)."""
+
+    def __init__(self, client):
+        self.client = client
+        self._applied = 0
+
+    def poll(self) -> List[dict]:
+        from dss_tpu.region.client import RegionError, SnapshotRequired
+
+        out = []
+        try:
+            while True:
+                try:
+                    entries, head = self.client.fetch(self._applied)
+                except SnapshotRequired:
+                    snap = self.client.get_snapshot()
+                    if snap is None:
+                        return out
+                    idx, state = snap
+                    # the snapshot carries full docs: replace local
+                    # state wholesale, then resume tailing after it
+                    out.append({"t": "__replica_reset__", "state": state})
+                    self._applied = idx
+                    continue
+                for idx, recs in entries:
+                    if idx >= self._applied:
+                        out.extend(recs)
+                        self._applied = idx + 1
+                if self._applied >= head:
+                    return out
+        except RegionError:
+            return out  # transient; next poll retries
+
+
+class ShardedOpReplica:
+    """SCD-operations read replica on a ("dp", "sp") mesh, refreshed
+    from a WAL or region-log tail."""
+
+    def __init__(
+        self,
+        mesh,
+        *,
+        wal_path: Optional[str] = None,
+        region_client=None,
+        max_results: int = 512,
+        rebuild_min_interval_s: float = 0.0,
+    ):
+        if (wal_path is None) == (region_client is None):
+            raise ValueError("exactly one of wal_path / region_client")
+        self.mesh = mesh
+        self.max_results = max_results
+        self._tail = (
+            _WalTail(wal_path) if wal_path else _RegionTail(region_client)
+        )
+        self._records: Dict[str, Record] = {}
+        self._owners: Dict[str, int] = {}
+        self._dirty = False
+        self._mu = threading.Lock()  # guards records + tail + rebuild
+        self._snapshot: Optional[Tuple[ShardedDar, List[str]]] = None
+        self._applied_records = 0
+        self._rebuilds = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        del rebuild_min_interval_s  # reserved
+
+    # -- ingest ---------------------------------------------------------------
+
+    def _intern(self, owner: str) -> int:
+        return self._owners.setdefault(owner, len(self._owners))
+
+    def _rec_from_op_doc(self, doc: dict) -> Record:
+        op = codec.doc_to_op(doc)
+        keys = np.unique(
+            s2cell.cell_to_dar_key(np.asarray(op.cells, dtype=np.uint64))
+        )
+        from dss_tpu.clock import to_nanos
+
+        return Record(
+            entity_id=op.id,
+            keys=keys.astype(np.int32),
+            alt_lo=(
+                -np.inf if op.altitude_lower is None else float(op.altitude_lower)
+            ),
+            alt_hi=(
+                np.inf if op.altitude_upper is None else float(op.altitude_upper)
+            ),
+            t_start=to_nanos(op.start_time),
+            t_end=to_nanos(op.end_time),
+            owner_id=self._intern(op.owner),
+        )
+
+    def _apply_locked(self, rec: dict) -> None:
+        t = rec.get("t", "")
+        if t == "__replica_reset__":
+            self._records.clear()
+            for d in rec["state"].get("scd", {}).get("ops", []):
+                r = self._rec_from_op_doc(d)
+                self._records[r.entity_id] = r
+            self._dirty = True
+        elif t == "scd_op_put":
+            r = self._rec_from_op_doc(rec["doc"])
+            self._records[r.entity_id] = r
+            self._dirty = True
+        elif t == "scd_op_del":
+            if self._records.pop(rec["id"], None) is not None:
+                self._dirty = True
+        self._applied_records += 1
+
+    def poll_once(self) -> int:
+        """Ingest any new log records; -> number applied."""
+        with self._mu:
+            recs = self._tail.poll()
+            for rec in recs:
+                self._apply_locked(rec)
+            return len(recs)
+
+    def refresh(self) -> bool:
+        """Fold ingested records into a fresh ShardedDar and swap it in
+        (atomic for readers).  -> True if a new snapshot was published."""
+        with self._mu:
+            if not self._dirty and self._snapshot is not None:
+                return False
+            recs = list(self._records.values())
+            ids = [r.entity_id for r in recs]
+            dar = (
+                ShardedDar(recs, self.mesh, max_results=self.max_results)
+                if recs
+                else None
+            )
+            self._snapshot = (dar, ids)
+            self._dirty = False
+            self._rebuilds += 1
+        # warm the new snapshot's query executable OUTSIDE the lock:
+        # the jit cache keys on the snapshot's postings-run capacity,
+        # so a rebuild can mean a fresh XLA compile — paying it here
+        # keeps it off the first reader's request deadline
+        if dar is not None:
+            try:
+                dar.query_batch(
+                    np.full((1, 16), -1, np.int32),
+                    np.asarray([-np.inf], np.float32),
+                    np.asarray([np.inf], np.float32),
+                    np.asarray([NO_TIME_LO], np.int64),
+                    np.asarray([NO_TIME_HI], np.int64),
+                    now=0,
+                )
+            except Exception:  # noqa: BLE001 — warmup is best-effort
+                pass
+        return True
+
+    def sync(self) -> None:
+        """poll + refresh in one call (tests / benchmarks)."""
+        self.poll_once()
+        self.refresh()
+
+    # -- background tailing ---------------------------------------------------
+
+    def start(self, interval_s: float = 0.5) -> None:
+        def loop():
+            while not self._stop.wait(interval_s):
+                try:
+                    self.sync()
+                except Exception:  # noqa: BLE001 — keep the tailer alive
+                    import logging
+
+                    logging.getLogger("dss.replica").exception(
+                        "replica refresh failed"
+                    )
+
+        self._thread = threading.Thread(
+            target=loop, name="sharded-replica", daemon=True
+        )
+        self._thread.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    # -- serving reads --------------------------------------------------------
+
+    def query(
+        self,
+        keys: np.ndarray,  # int32 DAR keys
+        alt_lo: Optional[float] = None,
+        alt_hi: Optional[float] = None,
+        t_start: Optional[int] = None,
+        t_end: Optional[int] = None,
+        *,
+        now: int,
+    ) -> List[str]:
+        """Operation ids intersecting the query volume, from the
+        current snapshot (one atomic snapshot grab per query)."""
+        snap = self._snapshot
+        if snap is None or snap[0] is None:
+            return []
+        dar, ids = snap
+        keys = np.asarray(keys, np.int32).ravel()
+        if keys.size == 0:
+            return []
+        out = dar.query_batch(
+            keys[None, :],
+            np.asarray(
+                [-np.inf if alt_lo is None else alt_lo], np.float32
+            ),
+            np.asarray([np.inf if alt_hi is None else alt_hi], np.float32),
+            np.asarray(
+                [NO_TIME_LO if t_start is None else t_start], np.int64
+            ),
+            np.asarray([NO_TIME_HI if t_end is None else t_end], np.int64),
+            now=now,
+        )[0]
+        return sorted(ids[s] for s in out if s < len(ids))
+
+    def stats(self) -> dict:
+        snap = self._snapshot
+        return {
+            "replica_records": len(self._records),
+            "replica_snapshot_records": 0 if snap is None else len(snap[1]),
+            "replica_applied_records": self._applied_records,
+            "replica_rebuilds": self._rebuilds,
+            "replica_dirty": int(self._dirty),
+        }

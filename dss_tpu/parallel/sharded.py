@@ -243,6 +243,22 @@ class ShardedDar:
     ):
         """Run a batch of queries; returns list-of-lists of entity slots."""
         qn = keys_batch.shape[0]
+        # pad the key width to a pow2 bucket: K is data-dependent (area
+        # covering size) and an unpadded shape would compile a fresh
+        # executable per distinct K
+        kw = 16
+        while kw < keys_batch.shape[1]:
+            kw *= 2
+        if kw != keys_batch.shape[1]:
+            keys_batch = np.concatenate(
+                [
+                    keys_batch,
+                    np.full(
+                        (qn, kw - keys_batch.shape[1]), -1, np.int32
+                    ),
+                ],
+                axis=1,
+            )
         pad = (-qn) % self.dp
         if pad:
             keys_batch = np.concatenate(

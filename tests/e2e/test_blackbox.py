@@ -372,3 +372,73 @@ def test_region_two_instance_interop_over_http(region_stack):
         timeout=5,
     )
     assert r.status_code == 200, r.text
+
+
+def test_sharded_replica_surface(certs, oauth, tmp_path_factory):
+    """The --sharded_replica flag end to end: the server binary tails
+    its own WAL into a ShardedDar on an 8-virtual-device mesh and
+    serves area searches from it at /aux/v1/replica/operations."""
+    wal = tmp_path_factory.mktemp("replicawal") / "dss.wal"
+    port = free_port()
+    p = Proc(
+        [
+            "dss_tpu.cmds.server",
+            "--addr", f":{port}",
+            "--enable_scd",
+            "--storage", "memory",
+            "--wal_path", str(wal),
+            "--virtual_cpu_devices", "8",
+            "--sharded_replica", "2,4",
+            "--replica_refresh_interval", "0.1",
+            "--public_key_files", str(certs / "oauth.pem"),
+            "--accepted_jwt_audiences", "localhost",
+        ],
+        "dss-replica",
+    )
+    base = f"http://127.0.0.1:{port}"
+    try:
+        wait_healthy(f"{base}/healthy", p.p, "dss-replica")
+        lat = 46.3
+        op1 = str(uuid.uuid4())
+        r = requests.put(
+            f"{base}/dss/v1/operation_references/{op1}",
+            json=op_body("uss1", lat=lat),
+            headers=oauth.hdr(SCD_SCOPE, sub="uss1"),
+            timeout=5,
+        )
+        assert r.status_code == 200, r.text
+
+        area = area_str(lat=lat)
+        deadline = time.monotonic() + 120  # first mesh compile is slow
+        while True:
+            r = requests.get(
+                f"{base}/aux/v1/replica/operations",
+                params={"area": area},
+                headers=oauth.hdr(SCD_SCOPE, sub="uss1"),
+                timeout=90,
+            )
+            # a cold larger-K bucket may still be compiling: a 504
+            # (deadline) is acceptable while polling, anything else
+            # is a bug
+            if r.status_code == 504:
+                assert time.monotonic() < deadline, "compile never finished"
+                time.sleep(0.3)
+                continue
+            assert r.status_code == 200, r.text
+            body = r.json()
+            if op1 in body["operation_ids"]:
+                break
+            assert time.monotonic() < deadline, body
+            time.sleep(0.3)
+        assert body["replica"]["replica_snapshot_records"] >= 1
+        # auth enforced on the replica surface too
+        assert (
+            requests.get(
+                f"{base}/aux/v1/replica/operations",
+                params={"area": area},
+                timeout=5,
+            ).status_code
+            == 401
+        )
+    finally:
+        p.stop()

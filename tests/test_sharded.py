@@ -132,3 +132,129 @@ def test_query_batch_pads_to_dp():
         now=NOW,
     )
     assert len(got) == 3
+
+
+# -- replica: WAL tail -> serving ShardedDar (SURVEY §7 step 7) -------------
+
+
+def _op_params_at(lat):
+    import time as _t
+
+    now = _t.time()
+
+    def iso(off):
+        import time as _tt
+
+        return _tt.strftime(
+            "%Y-%m-%dT%H:%M:%S", _tt.gmtime(now + off)
+        ) + "Z"
+
+    return {
+        "extents": [
+            {
+                "volume": {
+                    "outline_polygon": {
+                        "vertices": [
+                            {"lat": lat, "lng": -100.0},
+                            {"lat": lat + 0.02, "lng": -100.0},
+                            {"lat": lat + 0.02, "lng": -99.98},
+                            {"lat": lat, "lng": -99.98},
+                        ]
+                    },
+                    "altitude_lower": {
+                        "value": 50.0, "reference": "W84", "units": "M"
+                    },
+                    "altitude_upper": {
+                        "value": 200.0, "reference": "W84", "units": "M"
+                    },
+                },
+                "time_start": {"value": iso(60), "format": "RFC3339"},
+                "time_end": {"value": iso(3600), "format": "RFC3339"},
+            }
+        ],
+        "uss_base_url": "https://uss1.example.com",
+        "new_subscription": {"uss_base_url": "https://uss1.example.com"},
+        "state": "Accepted",
+        "old_version": 0,
+        "key": [],
+    }
+
+
+def test_replica_tails_live_wal_into_sharded_dar(tmp_path):
+    """A live standalone store's WAL replays into a serving ShardedDar
+    on the 8-device mesh; reads are consistent across refreshes."""
+    import threading
+    import time as _t
+    import uuid
+
+    from dss_tpu.clock import Clock
+    from dss_tpu.dar.dss_store import DSSStore
+    from dss_tpu.geo import covering as geo_covering
+    from dss_tpu.geo import s2cell
+    from dss_tpu.parallel.replica import ShardedOpReplica
+    from dss_tpu.services.scd import SCDService
+
+    wal = tmp_path / "dss.wal"
+    store = DSSStore(storage="memory", wal_path=str(wal))
+    scd = SCDService(store.scd, store.clock)
+
+    mesh = make_mesh(8, dp=2, sp=4)
+    rep = ShardedOpReplica(mesh, wal_path=str(wal))
+
+    # first wave of ops
+    ids1 = [str(uuid.uuid4()) for _ in range(5)]
+    for i, op_id in enumerate(ids1):
+        scd.put_operation(op_id, _op_params_at(40.0 + i * 0.1), "uss1")
+    rep.sync()
+
+    def area_keys(lat):
+        cells = geo_covering.covering_polygon(
+            [(lat, -100.0), (lat + 0.02, -100.0),
+             (lat + 0.02, -99.98), (lat, -99.98)]
+        )
+        return s2cell.cell_to_dar_key(cells)
+
+    now = int(_t.time() * 1e9)
+    for i, op_id in enumerate(ids1):
+        got = rep.query(area_keys(40.0 + i * 0.1), now=now)
+        assert op_id in got, (i, got)
+
+    # concurrent reads during a second wave of writes + refreshes only
+    # ever see complete snapshots (one of the valid states, no partial)
+    valid_counts = {len(ids1), len(ids1) + 1, len(ids1) + 2}
+    stop = threading.Event()
+    errors_seen = []
+    wide = np.unique(
+        np.concatenate([area_keys(40.0 + i * 0.1) for i in range(7)])
+    )
+
+    def reader():
+        while not stop.is_set():
+            got = rep.query(wide, now=now)
+            if len(got) not in valid_counts:
+                errors_seen.append(len(got))
+
+    th = threading.Thread(target=reader)
+    th.start()
+    ids2 = [str(uuid.uuid4()) for _ in range(2)]
+    for j, op_id in enumerate(ids2):
+        scd.put_operation(op_id, _op_params_at(40.5 + j * 0.1), "uss1")
+        rep.sync()
+    stop.set()
+    th.join(timeout=10)
+    assert not errors_seen, f"partial snapshots observed: {errors_seen}"
+
+    got = rep.query(wide, now=now)
+    assert sorted(got) == sorted(ids1 + ids2)
+
+    # deletes propagate too
+    scd.delete_operation(ids1[0], "uss1")
+    rep.sync()
+    got = rep.query(wide, now=now)
+    assert ids1[0] not in got and sorted(got) == sorted(ids1[1:] + ids2)
+
+    st = rep.stats()
+    assert st["replica_rebuilds"] >= 3
+    assert st["replica_snapshot_records"] == len(ids1) - 1 + len(ids2)
+    rep.close()
+    store.close()
