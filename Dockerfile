@@ -1,0 +1,18 @@
+# TPU-native DSS server image (the analog of the reference's
+# single-binary Dockerfile).  The CPU jax wheel is installed by
+# default; on TPU hosts swap in the libtpu wheel at build time:
+#   docker build --build-arg JAX_EXTRA="jax[tpu]" .
+FROM python:3.12-slim
+
+ARG JAX_EXTRA=""
+
+WORKDIR /app
+COPY pyproject.toml README.md ./
+COPY dss_tpu ./dss_tpu
+RUN pip install --no-cache-dir . ${JAX_EXTRA}
+
+# flags mirror cmds/grpc-backend (see dss_tpu/cmds/server.py --help)
+EXPOSE 8082
+ENTRYPOINT ["dss-server"]
+CMD ["--addr", ":8082", "--enable_scd", "--storage", "tpu", \
+     "--insecure_no_auth"]

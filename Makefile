@@ -1,0 +1,36 @@
+# Developer entry points (the reference's Makefile analog).
+#
+#   make test       unit + integration suite (virtual 8-device CPU mesh)
+#   make e2e        black-box suite against the real binaries
+#   make bench      the headline north-star benchmark (one JSON line)
+#   make bench-all  all BASELINE.md measurement configs
+#   make serve      run a local insecure server on :8082
+#   make docker     build the server image
+
+PY ?= python
+
+.PHONY: test e2e bench bench-all serve region-serve docker
+
+test:
+	$(PY) -m pytest tests/ -q
+
+e2e:
+	./test/e2e.sh
+
+bench:
+	$(PY) bench.py
+
+bench-all: bench
+	$(PY) benchmarks/bench_rid_search.py
+	$(PY) benchmarks/bench_fanout.py
+	$(PY) benchmarks/bench_sharded_replay.py
+
+serve:
+	$(PY) -m dss_tpu.cmds.server --addr :8082 --enable_scd \
+	    --storage tpu --insecure_no_auth
+
+region-serve:
+	$(PY) -m dss_tpu.cmds.region_server --addr :8090
+
+docker:
+	docker build -t dss-tpu .

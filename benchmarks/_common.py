@@ -1,0 +1,110 @@
+"""Shared helpers for the BASELINE.md measurement-config benchmarks.
+
+Each bench_*.py prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": x, "detail": {...}}
+
+The headline driver bench is /root/repo/bench.py (north-star config 4);
+these cover BASELINE.md configs 1 (RID search via the real HTTP stack),
+3 (subscription-notification fanout storm, standalone + region), and
+5 (WAL replay into the multi-chip ShardedDar).  Run them all via
+`make bench-all`.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+import time
+
+import numpy as np
+
+
+def emit(metric, value, unit, vs_baseline, detail):
+    print(
+        json.dumps(
+            {
+                "metric": metric,
+                "value": round(float(value), 1),
+                "unit": unit,
+                "vs_baseline": (
+                    None if vs_baseline is None else round(vs_baseline, 3)
+                ),
+                "detail": detail,
+            }
+        )
+    )
+
+
+def pctl(sorted_s, q):
+    if not len(sorted_s):
+        return None
+    return float(sorted_s[min(int(len(sorted_s) * q), len(sorted_s) - 1)])
+
+
+class LiveApp:
+    """Run an aiohttp app on an ephemeral localhost port (real sockets)."""
+
+    def __init__(self, app):
+        from aiohttp import web
+
+        self.app = app
+        self.loop = asyncio.new_event_loop()
+        self.port = None
+        self._started = threading.Event()
+        self._web = web
+        self.thread = threading.Thread(target=self._run, daemon=True)
+        self.thread.start()
+        assert self._started.wait(60)
+        self.base = f"http://127.0.0.1:{self.port}"
+
+    def _run(self):
+        asyncio.set_event_loop(self.loop)
+        runner = self._web.AppRunner(self.app)
+        self.loop.run_until_complete(runner.setup())
+        site = self._web.TCPSite(runner, "127.0.0.1", 0)
+        self.loop.run_until_complete(site.start())
+        self.port = site._server.sockets[0].getsockname()[1]
+        self._started.set()
+        self.loop.run_forever()
+
+    def stop(self):
+        self.loop.call_soon_threadsafe(self.loop.stop)
+        self.thread.join(timeout=10)
+
+
+def closed_loop(fn, threads, warm_s, run_s):
+    """N closed-loop client threads -> (qps, p50_ms, p99_ms, samples)."""
+    stop = threading.Event()
+    warm_until = time.perf_counter() + warm_s
+    lats = [[] for _ in range(threads)]
+
+    def client(i):
+        while not stop.is_set():
+            t0 = time.perf_counter()
+            fn(i)
+            t1 = time.perf_counter()
+            if t1 >= warm_until:
+                lats[i].append(t1 - t0)
+
+    ths = [
+        threading.Thread(target=client, args=(i,)) for i in range(threads)
+    ]
+    for t in ths:
+        t.start()
+    time.sleep(warm_s + run_s)
+    stop.set()
+    for t in ths:
+        t.join()
+    alll = np.sort(np.concatenate([np.asarray(x) for x in lats]))
+    return (
+        len(alll) / run_s,
+        (pctl(alll, 0.5) or 0) * 1000,
+        (pctl(alll, 0.99) or 0) * 1000,
+        int(len(alll)),
+    )
+
+
+def now_iso(offset_s=0):
+    t = time.time() + offset_s
+    return time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime(t)) + "Z"

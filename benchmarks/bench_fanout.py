@@ -1,0 +1,164 @@
+"""BASELINE.md config 3: subscription-notification fanout under an
+overlapping-area write storm, in BOTH standalone and region mode.
+
+N RID subscriptions (distinct owners, same metro area) overlap every
+write; each ISA upsert must bump + return all of them
+(pkg/rid/cockroach/subscriptions.go:128-173).  The region leg exposes
+the write path's lease + catch-up + batch-append cost (VERDICT r3
+weak #4) with numbers.
+
+  python benchmarks/bench_fanout.py
+Env: DSS_BENCH_SUBS (200), DSS_BENCH_WRITES (150),
+     DSS_BENCH_STORAGE (memory)
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+import uuid
+
+os.environ.setdefault("DSS_LOG_LEVEL", "error")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import dss_tpu.ops.conflict  # noqa: F401,E402 — x64 before jax init
+from benchmarks._common import emit, now_iso, pctl  # noqa: E402
+
+
+def _extents(lat, half=0.02):
+    return {
+        "spatial_volume": {
+            "footprint": {
+                "vertices": [
+                    {"lat": lat - half, "lng": -100.0 - half},
+                    {"lat": lat - half, "lng": -100.0 + half},
+                    {"lat": lat + half, "lng": -100.0 + half},
+                    {"lat": lat + half, "lng": -100.0 - half},
+                ]
+            },
+            "altitude_lo": 20.0,
+            "altitude_hi": 400.0,
+        },
+        "time_start": now_iso(60),
+        "time_end": now_iso(3600),
+    }
+
+
+def run_mode(store, n_subs, n_writes):
+    from dss_tpu.services.rid import RIDService
+
+    svc = RIDService(store.rid, store.clock)
+    # storm: n_subs subscriptions, one per owner (DSS0030 caps per-owner
+    # density), all overlapping the write area
+    for i in range(n_subs):
+        svc.create_subscription(
+            str(uuid.uuid4()),
+            {
+                "extents": _extents(40.0),
+                "callbacks": {
+                    "identification_service_area_url":
+                        f"https://uss{i}.example.com/isa"
+                },
+            },
+            f"uss{i}",
+        )
+    lats = []
+    notified = 0
+    t0 = time.perf_counter()
+    for k in range(n_writes):
+        w0 = time.perf_counter()
+        out = svc.create_isa(
+            str(uuid.uuid4()),
+            {
+                "extents": _extents(40.0),
+                "flights_url": "https://writer.example.com/f",
+            },
+            "writer-uss",
+        )
+        lats.append(time.perf_counter() - w0)
+        notified += len(out["subscribers"])
+    dt = time.perf_counter() - t0
+    s = np.sort(np.asarray(lats))
+    return {
+        "writes_per_s": round(n_writes / dt, 1),
+        "write_p50_ms": round((pctl(s, 0.5) or 0) * 1000, 2),
+        "write_p99_ms": round((pctl(s, 0.99) or 0) * 1000, 2),
+        "subs_notified_per_write": round(notified / n_writes, 1),
+        "notifications_per_s": round(notified / dt, 1),
+    }
+
+
+def main():
+    n_subs = int(os.environ.get("DSS_BENCH_SUBS", 200))
+    n_writes = int(os.environ.get("DSS_BENCH_WRITES", 150))
+    storage = os.environ.get("DSS_BENCH_STORAGE", "memory")
+
+    from dss_tpu.dar.dss_store import DSSStore
+
+    # -- standalone
+    store = DSSStore(storage=storage)
+    standalone = run_mode(store, n_subs, n_writes)
+    store.close()
+
+    # -- region mode: a real log server over localhost HTTP
+    import asyncio
+    import threading
+
+    from aiohttp import web
+
+    from dss_tpu.region.log_server import build_region_app
+
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+    holder = {}
+
+    def run_srv():
+        asyncio.set_event_loop(loop)
+        runner = web.AppRunner(build_region_app(None))
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        loop.run_until_complete(site.start())
+        holder["port"] = site._server.sockets[0].getsockname()[1]
+        started.set()
+        loop.run_forever()
+
+    th = threading.Thread(target=run_srv, daemon=True)
+    th.start()
+    assert started.wait(30)
+    store = DSSStore(
+        storage=storage,
+        region_url=f"http://127.0.0.1:{holder['port']}",
+        region_poll_interval_s=0.05,
+        instance_id="bench-writer",
+    )
+    region = run_mode(store, n_subs, n_writes)
+    store.close()
+    loop.call_soon_threadsafe(loop.stop)
+    th.join(timeout=10)
+
+    emit(
+        "sub_fanout_storm_writes_per_s",
+        standalone["writes_per_s"],
+        "writes/s",
+        None,
+        {
+            "subs": n_subs,
+            "writes": n_writes,
+            "storage": storage,
+            "standalone": standalone,
+            "region": region,
+            "region_write_overhead_x": round(
+                standalone["writes_per_s"]
+                / max(region["writes_per_s"], 1e-9),
+                2,
+            ),
+        },
+    )
+
+
+if __name__ == "__main__":
+    main()

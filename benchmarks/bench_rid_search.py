@@ -1,0 +1,122 @@
+"""BASELINE.md config 1: RID SearchIdentificationServiceAreas over 1k
+synthetic ISAs, through the REAL HTTP stack (auth + routing + service +
+store), prober-style.
+
+Baseline: no published reference number (BASELINE.md) — vs_baseline is
+reported against a 1k qps working target for a single instance.
+
+  python benchmarks/bench_rid_search.py
+Env: DSS_BENCH_ISAS (1000), DSS_BENCH_THREADS (16),
+     DSS_BENCH_SECS (10), DSS_BENCH_STORAGE (tpu)
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import uuid
+
+os.environ.setdefault("DSS_LOG_LEVEL", "error")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+import requests  # noqa: E402
+
+import dss_tpu.ops.conflict  # noqa: F401,E402 — x64 before jax init
+from benchmarks._common import LiveApp, closed_loop, emit, now_iso  # noqa: E402
+
+
+def main():
+    n_isas = int(os.environ.get("DSS_BENCH_ISAS", 1000))
+    threads = int(os.environ.get("DSS_BENCH_THREADS", 16))
+    secs = float(os.environ.get("DSS_BENCH_SECS", 10))
+    storage = os.environ.get("DSS_BENCH_STORAGE", "tpu")
+
+    from dss_tpu.api.app import build_app
+    from dss_tpu.clock import Clock
+    from dss_tpu.dar.dss_store import DSSStore
+    from dss_tpu.services.rid import RIDService
+
+    clock = Clock()
+    store = DSSStore(storage=storage, clock=clock)
+    rid = RIDService(store.rid, clock)
+    # auth stays on the request path in spirit: no authorizer object
+    # means the route handler skips JWT checks but everything else
+    # (routing, parsing, coalescer, store) is the serving stack
+    app = build_app(rid, None, None, default_timeout_s=60.0)
+    srv = LiveApp(app)
+
+    # one metro region; each ISA is a small polygon
+    rng = np.random.default_rng(0)
+    lat0, lng0 = 40.0, -100.0
+    span = 1.0  # ~111 km metro
+    t_session = requests.Session()
+    for k in range(n_isas):
+        la = float(lat0 + rng.uniform(0, span))
+        ln = float(lng0 + rng.uniform(0, span))
+        body = {
+            "extents": {
+                "spatial_volume": {
+                    "footprint": {
+                        "vertices": [
+                            {"lat": la, "lng": ln},
+                            {"lat": la + 0.01, "lng": ln},
+                            {"lat": la + 0.01, "lng": ln + 0.01},
+                            {"lat": la, "lng": ln + 0.01},
+                        ]
+                    },
+                    "altitude_lo": 20.0,
+                    "altitude_hi": 400.0,
+                },
+                "time_start": now_iso(60),
+                "time_end": now_iso(3600),
+            },
+            "flights_url": "https://uss.example.com/flights",
+        }
+        r = t_session.put(
+            f"{srv.base}/v1/dss/identification_service_areas/{uuid.uuid4()}",
+            json=body,
+            timeout=60,
+        )
+        assert r.status_code == 200, r.text
+
+    sessions = [requests.Session() for _ in range(threads)]
+    rngs = [np.random.default_rng(1000 + i) for i in range(threads)]
+
+    def one_search(i):
+        r = rngs[i]
+        la = float(lat0 + r.uniform(0, span - 0.05))
+        ln = float(lng0 + r.uniform(0, span - 0.05))
+        area = (
+            f"{la},{ln},{la + 0.04},{ln},{la + 0.04},{ln + 0.04},"
+            f"{la},{ln + 0.04}"
+        )
+        resp = sessions[i].get(
+            f"{srv.base}/v1/dss/identification_service_areas",
+            params={"area": area},
+            timeout=60,
+        )
+        assert resp.status_code == 200, resp.text
+
+    qps, p50, p99, n = closed_loop(one_search, threads, warm_s=3.0, run_s=secs)
+    srv.stop()
+    emit(
+        "rid_search_http_qps_1k_isas",
+        qps,
+        "searches/s",
+        qps / 1000.0,
+        {
+            "isas": n_isas,
+            "threads": threads,
+            "p50_ms": round(p50, 2),
+            "p99_ms": round(p99, 2),
+            "samples": n,
+            "storage": storage,
+            "path": "HTTP -> routes -> RIDService -> store index",
+        },
+    )
+
+
+if __name__ == "__main__":
+    main()

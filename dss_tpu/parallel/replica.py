@@ -23,6 +23,7 @@ Sources:
 from __future__ import annotations
 
 import json
+import logging
 import os
 import threading
 from typing import Dict, List, Optional, Tuple
@@ -34,6 +35,8 @@ from dss_tpu.dar.oracle import Record
 from dss_tpu.geo import s2cell
 from dss_tpu.ops.conflict import NO_TIME_HI, NO_TIME_LO
 from dss_tpu.parallel.sharded import ShardedDar
+
+log = logging.getLogger("dss.replica")
 
 
 class _WalTail:
@@ -79,6 +82,7 @@ class _RegionTail:
     def __init__(self, client):
         self.client = client
         self._applied = 0
+        self.errors = 0  # consecutive fetch failures (operability)
 
     def poll(self) -> List[dict]:
         from dss_tpu.region.client import RegionError, SnapshotRequired
@@ -88,6 +92,7 @@ class _RegionTail:
             while True:
                 try:
                     entries, head = self.client.fetch(self._applied)
+                    self.errors = 0
                 except SnapshotRequired:
                     snap = self.client.get_snapshot()
                     if snap is None:
@@ -104,8 +109,15 @@ class _RegionTail:
                         self._applied = idx + 1
                 if self._applied >= head:
                     return out
-        except RegionError:
-            return out  # transient; next poll retries
+        except RegionError as e:
+            # transient (next poll retries) — but a replica cut off
+            # from the region must be VISIBLY stale, not silently so
+            self.errors += 1
+            log.warning(
+                "replica region tail failed (%d consecutive): %s",
+                self.errors, e,
+            )
+            return out
 
 
 class ShardedOpReplica:
@@ -119,7 +131,6 @@ class ShardedOpReplica:
         wal_path: Optional[str] = None,
         region_client=None,
         max_results: int = 512,
-        rebuild_min_interval_s: float = 0.0,
     ):
         if (wal_path is None) == (region_client is None):
             raise ValueError("exactly one of wal_path / region_client")
@@ -134,10 +145,10 @@ class ShardedOpReplica:
         self._mu = threading.Lock()  # guards records + tail + rebuild
         self._snapshot: Optional[Tuple[ShardedDar, List[str]]] = None
         self._applied_records = 0
+        self._apply_errors = 0
         self._rebuilds = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        del rebuild_min_interval_s  # reserved
 
     # -- ingest ---------------------------------------------------------------
 
@@ -183,11 +194,21 @@ class ShardedOpReplica:
         self._applied_records += 1
 
     def poll_once(self) -> int:
-        """Ingest any new log records; -> number applied."""
+        """Ingest any new log records; -> number applied.  One record
+        that fails to apply (version skew, corrupt doc) is skipped and
+        counted — it must not drop the rest of its batch (the tail
+        cursor has already advanced past it)."""
         with self._mu:
             recs = self._tail.poll()
             for rec in recs:
-                self._apply_locked(rec)
+                try:
+                    self._apply_locked(rec)
+                except Exception:  # noqa: BLE001 — isolate bad records
+                    self._apply_errors += 1
+                    log.exception(
+                        "replica failed to apply record %r; skipped",
+                        rec.get("t"),
+                    )
             return len(recs)
 
     def refresh(self) -> bool:
@@ -203,13 +224,13 @@ class ShardedOpReplica:
                 if recs
                 else None
             )
-            self._snapshot = (dar, ids)
+            # records ingested while we build/warm re-mark dirty and
+            # are picked up by the next refresh
             self._dirty = False
-            self._rebuilds += 1
-        # warm the new snapshot's query executable OUTSIDE the lock:
+        # warm the new snapshot's query executable BEFORE publishing:
         # the jit cache keys on the snapshot's postings-run capacity,
-        # so a rebuild can mean a fresh XLA compile — paying it here
-        # keeps it off the first reader's request deadline
+        # so a rebuild can mean a fresh XLA compile — readers keep
+        # hitting the old snapshot until the warmed one swaps in
         if dar is not None:
             try:
                 dar.query_batch(
@@ -222,6 +243,9 @@ class ShardedOpReplica:
                 )
             except Exception:  # noqa: BLE001 — warmup is best-effort
                 pass
+        with self._mu:
+            self._snapshot = (dar, ids)
+            self._rebuilds += 1
         return True
 
     def sync(self) -> None:
@@ -294,6 +318,8 @@ class ShardedOpReplica:
             "replica_records": len(self._records),
             "replica_snapshot_records": 0 if snap is None else len(snap[1]),
             "replica_applied_records": self._applied_records,
+            "replica_apply_errors": self._apply_errors,
+            "replica_tail_errors": getattr(self._tail, "errors", 0),
             "replica_rebuilds": self._rebuilds,
             "replica_dirty": int(self._dirty),
         }
