@@ -105,40 +105,19 @@ def main():
     store.close()
 
     # -- region mode: a real log server over localhost HTTP
-    import asyncio
-    import threading
-
-    from aiohttp import web
-
+    from benchmarks._common import LiveApp
     from dss_tpu.region.log_server import build_region_app
 
-    loop = asyncio.new_event_loop()
-    started = threading.Event()
-    holder = {}
-
-    def run_srv():
-        asyncio.set_event_loop(loop)
-        runner = web.AppRunner(build_region_app(None))
-        loop.run_until_complete(runner.setup())
-        site = web.TCPSite(runner, "127.0.0.1", 0)
-        loop.run_until_complete(site.start())
-        holder["port"] = site._server.sockets[0].getsockname()[1]
-        started.set()
-        loop.run_forever()
-
-    th = threading.Thread(target=run_srv, daemon=True)
-    th.start()
-    assert started.wait(30)
+    srv = LiveApp(build_region_app(None))
     store = DSSStore(
         storage=storage,
-        region_url=f"http://127.0.0.1:{holder['port']}",
+        region_url=srv.base,
         region_poll_interval_s=0.05,
         instance_id="bench-writer",
     )
     region = run_mode(store, n_subs, n_writes)
     store.close()
-    loop.call_soon_threadsafe(loop.stop)
-    th.join(timeout=10)
+    srv.stop()
 
     emit(
         "sub_fanout_storm_writes_per_s",

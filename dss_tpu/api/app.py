@@ -23,6 +23,7 @@ from __future__ import annotations
 import asyncio
 import functools
 import json
+import time
 from typing import Optional
 
 from aiohttp import web
@@ -56,6 +57,7 @@ RID_SCOPES = {
     _RID + "GetSubscription": require_all_scopes(RID_READ),
     _RID + "SearchSubscriptions": require_all_scopes(RID_READ),
     _AUX + "ValidateOauth": require_all_scopes(RID_WRITE),
+    _AUX + "DebugProfile": require_all_scopes(RID_WRITE),
 }
 
 SCD_SCOPES = {
@@ -102,6 +104,25 @@ async def error_middleware(request, handler):
         return _error_response(errors.internal(str(e)))
 
 
+def make_trace_middleware():
+    """Per-request tracing (the reference's --trace-requests analog,
+    pkg/logging/http.go:36-55, upgraded): assigns/propagates an
+    X-Request-Id, collects per-stage timings (auth_ms, service_ms) that
+    the access log emits, and returns the id on the response so USS
+    operators can correlate DSS logs with their own."""
+    import uuid as _uuid
+
+    @web.middleware
+    async def trace_middleware(request, handler):
+        rid = request.headers.get("X-Request-Id") or _uuid.uuid4().hex[:16]
+        request["dss_trace"] = {"request_id": rid, "stages": {}}
+        resp = await handler(request)
+        resp.headers["X-Request-Id"] = rid
+        return resp
+
+    return trace_middleware
+
+
 def make_timeout_middleware(timeout_s: float):
     """Per-request deadline (the reference's 10 s default RPC timeout,
     cmds/grpc-backend/main.go:48): a handler that exceeds it gets a 504
@@ -113,7 +134,8 @@ def make_timeout_middleware(timeout_s: float):
 
     @web.middleware
     async def timeout_middleware(request, handler):
-        if request.path == "/healthy":
+        # /debug/profile deliberately runs longer than any deadline
+        if request.path in ("/healthy", "/debug/profile"):
             return await handler(request)
         try:
             return await asyncio.wait_for(handler(request), timeout_s)
@@ -127,14 +149,30 @@ def make_timeout_middleware(timeout_s: float):
     return timeout_middleware
 
 
-async def _call(fn, *args):
+async def _call(fn, *args, request=None):
     """Run a synchronous service call off the event loop.  The service
     layer holds the store lock and may run multi-ms TPU kernels (first
     call: a multi-second jit compile); keeping it off the loop lets
     other requests (and /healthy) proceed — the goroutine-per-RPC
-    analog of grpc-go."""
+    analog of grpc-go.  When `request` is given, the service duration
+    lands in its trace stages (--trace_requests)."""
     loop = asyncio.get_running_loop()
-    return await loop.run_in_executor(None, functools.partial(fn, *args))
+    t0 = time.perf_counter()
+    try:
+        return await loop.run_in_executor(
+            None, functools.partial(fn, *args)
+        )
+    finally:
+        tr = None if request is None else request.get("dss_trace")
+        if tr is not None:
+            tr["stages"]["service_ms"] = round(
+                (time.perf_counter() - t0) * 1000, 3
+            )
+
+
+async def _call_r(request, fn, *args):
+    """Handler-side _call: threads the request through for tracing."""
+    return await _call(fn, *args, request=request)
 
 
 async def _params(request) -> dict:
@@ -161,12 +199,16 @@ def build_app(
     stats_fn=None,
     default_timeout_s: float = 10.0,
     replica=None,  # ShardedOpReplica: multi-chip read-replica surface
+    trace_requests: bool = False,
+    profile_dir: str = "",
 ) -> web.Application:
     from dss_tpu.obs.logging import make_access_log_middleware
 
     middlewares = [
         make_access_log_middleware(metrics, dump_requests=dump_requests),
     ]
+    if trace_requests:
+        middlewares.append(make_trace_middleware())
     if default_timeout_s and default_timeout_s > 0:
         middlewares.append(make_timeout_middleware(default_timeout_s))
     middlewares.append(error_middleware)
@@ -176,9 +218,17 @@ def build_app(
         """-> owner.  No authorizer configured (unit harness) -> anon."""
         if authorizer is None:
             return "anonymous"
-        owner = authorizer.authorize(
-            request.headers.get("Authorization"), operation
-        )
+        t0 = time.perf_counter()
+        try:
+            owner = authorizer.authorize(
+                request.headers.get("Authorization"), operation
+            )
+        finally:
+            tr = request.get("dss_trace")
+            if tr is not None:
+                tr["stages"]["auth_ms"] = round(
+                    (time.perf_counter() - t0) * 1000, 3
+                )
         request["dss_owner"] = owner
         return owner
 
@@ -195,7 +245,7 @@ def build_app(
             if stats_fn is not None:
                 # stats take the store lock (writers hold it across
                 # device work) — keep the event loop free
-                stats = await _call(stats_fn)
+                stats = await _call_r(request, stats_fn)
                 for name, val in stats.items():
                     metrics.set_gauge(name, val)
             return web.Response(
@@ -218,6 +268,45 @@ def build_app(
         return web.json_response({})
 
     app.router.add_get("/aux/v1/validate_oauth", validate_oauth)
+
+    if profile_dir:
+        # opt-in device profiling (the reference's Cloud-Profiler
+        # --gcp_prof_service_name analog, grpc-backend main.go:235-241,
+        # recast TPU-native): POST /debug/profile?seconds=N captures a
+        # JAX/XLA device trace into profile_dir while live traffic
+        # keeps flowing; view with TensorBoard or xprof
+        import threading as _threading
+
+        profile_lock = _threading.Lock()
+
+        async def debug_profile(request):
+            auth(request, _AUX + "DebugProfile")
+            try:
+                seconds = float(request.query.get("seconds", 3.0))
+            except ValueError:
+                raise errors.bad_request("bad seconds param")
+            if not (0.0 < seconds <= 60.0):  # also rejects NaN
+                raise errors.bad_request(
+                    "seconds must be in (0, 60]"
+                )
+            if not profile_lock.acquire(blocking=False):
+                raise errors.unavailable("a profile capture is running")
+
+            def capture():
+                try:
+                    import jax
+
+                    with jax.profiler.trace(profile_dir):
+                        time.sleep(seconds)
+                finally:
+                    profile_lock.release()
+
+            await _call_r(request, capture)
+            return web.json_response(
+                {"profile_dir": profile_dir, "seconds": seconds}
+            )
+
+        app.router.add_post("/debug/profile", debug_profile)
 
     if replica is not None:
         # the multi-chip read-replica surface (SURVEY §7 step 7): area
@@ -263,7 +352,7 @@ def build_app(
                 except ValueError:
                     raise errors.bad_request(f"bad {name}: {raw!r}")
 
-            ids = await _call(
+            ids = await _call_r(request, 
                 functools.partial(
                     replica.query,
                     keys,
@@ -290,7 +379,7 @@ def build_app(
         async def isa_create(request):
             owner = auth(request, _RID + "CreateIdentificationServiceArea")
             return web.json_response(
-                await _call(rid.create_isa, 
+                await _call_r(request, rid.create_isa, 
                     request.match_info["id"], await _params(request), owner
                 )
             )
@@ -298,7 +387,7 @@ def build_app(
         async def isa_update(request):
             owner = auth(request, _RID + "UpdateIdentificationServiceArea")
             return web.json_response(
-                await _call(rid.update_isa, 
+                await _call_r(request, rid.update_isa, 
                     request.match_info["id"],
                     request.match_info["version"],
                     await _params(request),
@@ -309,7 +398,7 @@ def build_app(
         async def isa_delete(request):
             owner = auth(request, _RID + "DeleteIdentificationServiceArea")
             return web.json_response(
-                await _call(rid.delete_isa, 
+                await _call_r(request, rid.delete_isa, 
                     request.match_info["id"],
                     request.match_info["version"],
                     owner,
@@ -318,12 +407,12 @@ def build_app(
 
         async def isa_get(request):
             auth(request, _RID + "GetIdentificationServiceArea")
-            return web.json_response(await _call(rid.get_isa, request.match_info["id"]))
+            return web.json_response(await _call_r(request, rid.get_isa, request.match_info["id"]))
 
         async def isa_search(request):
             auth(request, _RID + "SearchIdentificationServiceAreas")
             return web.json_response(
-                await _call(rid.search_isas, 
+                await _call_r(request, rid.search_isas, 
                     request.query.get("area", ""),
                     request.query.get("earliest_time"),
                     request.query.get("latest_time"),
@@ -333,7 +422,7 @@ def build_app(
         async def sub_create(request):
             owner = auth(request, _RID + "CreateSubscription")
             return web.json_response(
-                await _call(rid.create_subscription, 
+                await _call_r(request, rid.create_subscription, 
                     request.match_info["id"], await _params(request), owner
                 )
             )
@@ -341,7 +430,7 @@ def build_app(
         async def sub_update(request):
             owner = auth(request, _RID + "UpdateSubscription")
             return web.json_response(
-                await _call(rid.update_subscription, 
+                await _call_r(request, rid.update_subscription, 
                     request.match_info["id"],
                     request.match_info["version"],
                     await _params(request),
@@ -352,7 +441,7 @@ def build_app(
         async def sub_delete(request):
             owner = auth(request, _RID + "DeleteSubscription")
             return web.json_response(
-                await _call(rid.delete_subscription, 
+                await _call_r(request, rid.delete_subscription, 
                     request.match_info["id"],
                     request.match_info["version"],
                     owner,
@@ -362,13 +451,13 @@ def build_app(
         async def sub_get(request):
             auth(request, _RID + "GetSubscription")
             return web.json_response(
-                await _call(rid.get_subscription, request.match_info["id"])
+                await _call_r(request, rid.get_subscription, request.match_info["id"])
             )
 
         async def sub_search(request):
             owner = auth(request, _RID + "SearchSubscriptions")
             return web.json_response(
-                await _call(rid.search_subscriptions, request.query.get("area", ""), owner)
+                await _call_r(request, rid.search_subscriptions, request.query.get("area", ""), owner)
             )
 
         base = "/v1/dss/identification_service_areas"
@@ -392,7 +481,7 @@ def build_app(
         async def op_put(request):
             owner = auth(request, _SCD + "PutOperationReference")
             return web.json_response(
-                await _call(scd.put_operation, 
+                await _call_r(request, scd.put_operation, 
                     request.match_info["entityuuid"],
                     await _params(request),
                     owner,
@@ -402,25 +491,25 @@ def build_app(
         async def op_get(request):
             owner = auth(request, _SCD + "GetOperationReference")
             return web.json_response(
-                await _call(scd.get_operation, request.match_info["entityuuid"], owner)
+                await _call_r(request, scd.get_operation, request.match_info["entityuuid"], owner)
             )
 
         async def op_delete(request):
             owner = auth(request, _SCD + "DeleteOperationReference")
             return web.json_response(
-                await _call(scd.delete_operation, request.match_info["entityuuid"], owner)
+                await _call_r(request, scd.delete_operation, request.match_info["entityuuid"], owner)
             )
 
         async def op_query(request):
             owner = auth(request, _SCD + "SearchOperationReferences")
             return web.json_response(
-                await _call(scd.search_operations, await _params(request), owner)
+                await _call_r(request, scd.search_operations, await _params(request), owner)
             )
 
         async def scd_sub_put(request):
             owner = auth(request, _SCD + "PutSubscription")
             return web.json_response(
-                await _call(scd.put_subscription, 
+                await _call_r(request, scd.put_subscription, 
                     request.match_info["subscriptionid"],
                     await _params(request),
                     owner,
@@ -430,7 +519,7 @@ def build_app(
         async def scd_sub_get(request):
             owner = auth(request, _SCD + "GetSubscription")
             return web.json_response(
-                await _call(scd.get_subscription, 
+                await _call_r(request, scd.get_subscription, 
                     request.match_info["subscriptionid"], owner
                 )
             )
@@ -438,7 +527,7 @@ def build_app(
         async def scd_sub_delete(request):
             owner = auth(request, _SCD + "DeleteSubscription")
             return web.json_response(
-                await _call(scd.delete_subscription, 
+                await _call_r(request, scd.delete_subscription, 
                     request.match_info["subscriptionid"], owner
                 )
             )
@@ -446,13 +535,13 @@ def build_app(
         async def scd_sub_query(request):
             owner = auth(request, _SCD + "QuerySubscriptions")
             return web.json_response(
-                await _call(scd.query_subscriptions, await _params(request), owner)
+                await _call_r(request, scd.query_subscriptions, await _params(request), owner)
             )
 
         async def constraint_put(request):
             auth(request, _SCD + "PutConstraintReference")
             return web.json_response(
-                await _call(scd.put_constraint, 
+                await _call_r(request, scd.put_constraint, 
                     request.match_info["entityuuid"], await _params(request)
                 )
             )
@@ -460,25 +549,25 @@ def build_app(
         async def constraint_get(request):
             auth(request, _SCD + "GetConstraintReference")
             return web.json_response(
-                await _call(scd.get_constraint, request.match_info["entityuuid"])
+                await _call_r(request, scd.get_constraint, request.match_info["entityuuid"])
             )
 
         async def constraint_delete(request):
             auth(request, _SCD + "DeleteConstraintReference")
             return web.json_response(
-                await _call(scd.delete_constraint, request.match_info["entityuuid"])
+                await _call_r(request, scd.delete_constraint, request.match_info["entityuuid"])
             )
 
         async def constraint_query(request):
             auth(request, _SCD + "QueryConstraintReferences")
             return web.json_response(
-                await _call(scd.query_constraints, await _params(request))
+                await _call_r(request, scd.query_constraints, await _params(request))
             )
 
         async def dss_report(request):
             auth(request, _SCD + "MakeDssReport")
             return web.json_response(
-                await _call(scd.make_dss_report, await _params(request))
+                await _call_r(request, scd.make_dss_report, await _params(request))
             )
 
         # exact /query routes registered before the {entityuuid} patterns

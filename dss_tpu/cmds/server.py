@@ -73,6 +73,20 @@ def make_parser() -> argparse.ArgumentParser:
         help="log request bodies (reference --dump_requests)",
     )
     p.add_argument(
+        "--trace_requests",
+        action="store_true",
+        help="per-request tracing: X-Request-Id propagation + "
+        "auth/service stage timings in the access log (reference "
+        "--trace-requests, pkg/logging/http.go:36-55)",
+    )
+    p.add_argument(
+        "--profile_dir",
+        default="",
+        help="enable POST /debug/profile?seconds=N: capture a JAX/XLA "
+        "device trace into this directory under live traffic "
+        "(reference --gcp_prof_service_name analog)",
+    )
+    p.add_argument(
         "--region_url",
         default="",
         help="region log server URL; joins this instance to a "
@@ -310,6 +324,8 @@ def build(args) -> web.Application:
         stats_fn=stats_fn,
         default_timeout_s=args.default_timeout,
         replica=replica,
+        trace_requests=args.trace_requests,
+        profile_dir=args.profile_dir,
     )
 
 

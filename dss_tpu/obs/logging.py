@@ -125,6 +125,10 @@ def make_access_log_middleware(metrics=None, dump_requests: bool = False):
             owner = request.get("dss_owner")
             if owner:
                 fields["owner"] = owner
+            tr = request.get("dss_trace")
+            if tr is not None:
+                fields["request_id"] = tr["request_id"]
+                fields.update(tr["stages"])
             if body is not None:
                 fields["request_body"] = body[:4096]
             log_fields(logger, logging.INFO, "request", **fields)

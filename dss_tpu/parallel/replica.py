@@ -143,6 +143,10 @@ class ShardedOpReplica:
         self._owners: Dict[str, int] = {}
         self._dirty = False
         self._mu = threading.Lock()  # guards records + tail + rebuild
+        # serializes whole refresh() runs: publish order must match
+        # build order (the warmup happens outside _mu, so without this
+        # a slower older build could overwrite a newer snapshot)
+        self._refresh_mu = threading.Lock()
         self._snapshot: Optional[Tuple[ShardedDar, List[str]]] = None
         self._applied_records = 0
         self._apply_errors = 0
@@ -179,10 +183,14 @@ class ShardedOpReplica:
     def _apply_locked(self, rec: dict) -> None:
         t = rec.get("t", "")
         if t == "__replica_reset__":
-            self._records.clear()
+            # build the replacement off to the side and swap only once
+            # every doc parsed: a corrupt doc mid-snapshot must not
+            # leave truncated state serving as complete
+            fresh = {}
             for d in rec["state"].get("scd", {}).get("ops", []):
                 r = self._rec_from_op_doc(d)
-                self._records[r.entity_id] = r
+                fresh[r.entity_id] = r
+            self._records = fresh
             self._dirty = True
         elif t == "scd_op_put":
             r = self._rec_from_op_doc(rec["doc"])
@@ -214,6 +222,10 @@ class ShardedOpReplica:
     def refresh(self) -> bool:
         """Fold ingested records into a fresh ShardedDar and swap it in
         (atomic for readers).  -> True if a new snapshot was published."""
+        with self._refresh_mu:
+            return self._refresh_serialized()
+
+    def _refresh_serialized(self) -> bool:
         with self._mu:
             if not self._dirty and self._snapshot is not None:
                 return False
@@ -261,11 +273,7 @@ class ShardedOpReplica:
                 try:
                     self.sync()
                 except Exception:  # noqa: BLE001 — keep the tailer alive
-                    import logging
-
-                    logging.getLogger("dss.replica").exception(
-                        "replica refresh failed"
-                    )
+                    log.exception("replica refresh failed")
 
         self._thread = threading.Thread(
             target=loop, name="sharded-replica", daemon=True
