@@ -116,7 +116,13 @@ def make_trace_middleware():
     async def trace_middleware(request, handler):
         rid = request.headers.get("X-Request-Id") or _uuid.uuid4().hex[:16]
         request["dss_trace"] = {"request_id": rid, "stages": {}}
-        resp = await handler(request)
+        try:
+            resp = await handler(request)
+        except web.HTTPException as e:
+            # error responses are the ones operators most need to
+            # correlate — tag them too
+            e.headers["X-Request-Id"] = rid
+            raise
         resp.headers["X-Request-Id"] = rid
         return resp
 
@@ -275,9 +281,15 @@ def build_app(
         # recast TPU-native): POST /debug/profile?seconds=N captures a
         # JAX/XLA device trace into profile_dir while live traffic
         # keeps flowing; view with TensorBoard or xprof
+        import concurrent.futures as _futures
         import threading as _threading
 
         profile_lock = _threading.Lock()
+        # dedicated executor: a 60 s capture must not occupy a slot of
+        # the shared pool that runs store-locked service calls
+        profile_pool = _futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="dss-profile"
+        )
 
         async def debug_profile(request):
             auth(request, _AUX + "DebugProfile")
@@ -301,7 +313,9 @@ def build_app(
                 finally:
                     profile_lock.release()
 
-            await _call_r(request, capture)
+            await asyncio.get_running_loop().run_in_executor(
+                profile_pool, capture
+            )
             return web.json_response(
                 {"profile_dir": profile_dir, "seconds": seconds}
             )

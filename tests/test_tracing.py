@@ -58,6 +58,11 @@ def test_request_id_assigned_and_propagated():
         ]
         assert any(f.get("request_id") == "corr-123" for f in recs)
         assert any("service_ms" in f for f in recs)
+        # error responses carry the id too (correlation matters most
+        # there)
+        r404 = requests.get(f"{srv.base}/no/such/route", timeout=5)
+        assert r404.status_code == 404
+        assert r404.headers.get("X-Request-Id")
     finally:
         access.removeHandler(cap)
         srv.stop()
