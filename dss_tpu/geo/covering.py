@@ -159,14 +159,25 @@ class Loop:
         self.n = len(v)
         self._origin = np.array([-0.0099994664, 0.0025924542, 0.9999466])
         self._origin /= np.linalg.norm(self._origin)
-        if self.n >= 3:
-            v1_inside = _ordered_ccw(
-                _ortho(self.v[1]), self.v[0], self.v[2], self.v[1]
-            )
-            contains_v1 = self._contains_assuming_origin_outside(self.v[1])
-            self._origin_inside = v1_inside != contains_v1
-        else:
-            self._origin_inside = False
+        # the origin-containment bootstrap costs a scalar crossing walk;
+        # computed lazily so area-only uses (the winding/limit checks)
+        # never pay it
+        self._origin_inside_cache = None
+
+    @property
+    def _origin_inside(self) -> bool:
+        if self._origin_inside_cache is None:
+            if self.n >= 3:
+                v1_inside = _ordered_ccw(
+                    _ortho(self.v[1]), self.v[0], self.v[2], self.v[1]
+                )
+                contains_v1 = self._contains_assuming_origin_outside(
+                    self.v[1]
+                )
+                self._origin_inside_cache = v1_inside != contains_v1
+            else:
+                self._origin_inside_cache = False
+        return self._origin_inside_cache
 
     def _crossing_parity(self, p):
         """Number of loop edges crossed by segment origin->p, mod 2
@@ -458,16 +469,85 @@ def covering_polyline(points_xyz) -> np.ndarray:
     return np.sort(np.array(sorted(result), dtype=np.uint64))
 
 
-def _loop_covering(loop: Loop) -> np.ndarray:
-    loop_vertex_cells = {
-        int(np.uint64(cell_id_from_point(loop.v[k], level=DAR_LEVEL)))
-        for k in range(loop.n)
-    }
+_RECT_MAX_CELLS = 1 << 16  # rect fast-path cap; beyond it BFS is better
+_RECT_CHUNK = 1 << 14  # candidate cells per predicate batch (memory)
+
+
+def _loop_covering_bfs(loop: Loop, loop_vertex_cells) -> np.ndarray:
+    """The wave-BFS covering (handles face wrap exactly); also the
+    differential reference for the rect fast path."""
     seeds = [np.uint64(c) for c in loop_vertex_cells]
     return _flood_fill(
         seeds,
         lambda wave: _cells_intersect_loop(wave, loop, loop_vertex_cells),
     )
+
+
+def _loop_covering(loop: Loop) -> np.ndarray:
+    vertex_ids = cell_id_from_point(loop.v, level=DAR_LEVEL)
+    loop_vertex_cells = {int(c) for c in np.atleast_1d(vertex_ids)}
+
+    # Single-face fast path: every cube face is a gnomonic plane, so a
+    # loop edge is a straight segment in UV and stays inside its
+    # endpoints' uv bbox; st(u) is monotonic per axis, so the whole
+    # boundary lies within the vertices' ij bounding rectangle.  The
+    # INTERIOR is only bbox-bounded when it is the small side of the
+    # boundary (<= the area gate) — a huge-interior loop (e.g. a circle
+    # built around the antipode, which never passes the polygon
+    # winding normalization) must take the BFS, where the cell-count
+    # cap raises AreaTooLarge instead of silently under-covering.
+    # One vectorized predicate pass over the rect (+1-cell touch
+    # margin), chunked for bounded temporaries, replaces the wave BFS —
+    # 3-4x faster for typical entity footprints.  Oversized rects
+    # (legal thin diagonal slivers) stay on the BFS, which only visits
+    # cells near the strip.
+    faces, i_lo, j_lo, size = s2cell.cell_ij_bounds(
+        np.atleast_1d(vertex_ids)
+    )
+    if (
+        len(set(int(f) for f in np.atleast_1d(faces))) == 1
+        and loop_area_km2(loop) <= MAX_AREA_KM2
+    ):
+        step = int(np.atleast_1d(size)[0])
+        lim = 1 << s2cell.MAX_LEVEL
+        imin = max(int(i_lo.min()) - step, 0)
+        imax = min(int(i_lo.max()) + step, lim - step)
+        jmin = max(int(j_lo.min()) - step, 0)
+        jmax = min(int(j_lo.max()) + step, lim - step)
+        ni = (imax - imin) // step + 1
+        nj = (jmax - jmin) // step + 1
+        if (
+            ni * nj <= _RECT_MAX_CELLS
+            and imin > 0
+            and jmin > 0
+            and imax < lim - step
+            and jmax < lim - step
+        ):
+            ii = imin + np.arange(ni, dtype=np.int64) * step
+            jj = jmin + np.arange(nj, dtype=np.int64) * step
+            cand = s2cell.cell_parent(
+                s2cell.from_face_ij(
+                    int(np.atleast_1d(faces)[0]),
+                    np.repeat(ii, nj) + step // 2,
+                    np.tile(jj, ni) + step // 2,
+                ),
+                DAR_LEVEL,
+            )
+            kept = []
+            for lo in range(0, len(cand), _RECT_CHUNK):
+                chunk = cand[lo : lo + _RECT_CHUNK]
+                keep = _cells_intersect_loop(
+                    chunk, loop, loop_vertex_cells
+                )
+                kept.append(chunk[keep])
+            out = np.unique(np.concatenate(kept))
+            if len(out) > _MAX_COVERING_CELLS:
+                raise AreaTooLargeError(
+                    "covering exceeds maximum cell count"
+                )
+            return out
+
+    return _loop_covering_bfs(loop, loop_vertex_cells)
 
 
 def covering_from_loop_points(points_xyz) -> np.ndarray:

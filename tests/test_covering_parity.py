@@ -320,3 +320,60 @@ def test_max_area_covering_speed():
     dt = time.perf_counter() - t0
     # 50 ms target locally; 5x headroom for loaded CI machines
     assert dt < 0.25, f"max-area covering took {dt*1000:.0f} ms"
+
+
+def bfs_covering(loop: Loop) -> np.ndarray:
+    """The production BFS path, bypassing the single-face rect fast
+    path — the differential reference for it."""
+    lvc = {
+        int(np.uint64(s2.cell_id_from_point(loop.v[k], level=DAR)))
+        for k in range(loop.n)
+    }
+    return C._loop_covering_bfs(loop, lvc)
+
+
+@pytest.mark.parametrize("case", range(len(ADVERSARIAL)))
+def test_rect_fast_path_matches_bfs(case):
+    """The single-face ij-rect fast path must produce exactly the BFS
+    flood fill's cell set (gnomonic-plane bbox argument in
+    covering._loop_covering)."""
+    loop = norm_loop(ADVERSARIAL[case])
+    assert np.array_equal(C._loop_covering(loop), bfs_covering(loop))
+
+
+def test_rect_fast_path_matches_bfs_max_area():
+    h = 0.08
+    loop = norm_loop(
+        [(40 - h, -100 - h), (40 - h, -100 + h),
+         (40 + h, -100 + h), (40 + h, -100 - h)]
+    )
+    fast = C._loop_covering(loop)
+    assert len(fast) > 200
+    assert np.array_equal(fast, bfs_covering(loop))
+
+
+def test_huge_interior_circle_never_undercovers():
+    """A circle with radius past pi/2 builds a loop whose interior is
+    nearly the whole sphere (it never passes the polygon winding
+    normalization).  The rect fast path must NOT claim it — the correct
+    outcome is AreaTooLarge via the BFS cell cap, never a silent small
+    covering that misses conflicts planet-wide."""
+    with pytest.raises(AreaTooLargeError):
+        covering_circle(40.0, -100.0, 19_900_000.0)
+
+
+def test_thin_sliver_stays_efficient():
+    """A legal thin diagonal sliver has a huge ij bbox; it must take
+    the BFS (which visits only cells near the strip), not a giant rect
+    scan."""
+    import time as _t
+
+    lls = [(40.0, -100.0), (40.5, -99.5), (40.501, -99.5)]
+    loop = norm_loop(lls)
+    assert loop_area_km2(loop) <= C.MAX_AREA_KM2
+    t0 = _t.perf_counter()
+    cells = C._loop_covering(loop)
+    dt = _t.perf_counter() - t0
+    assert len(cells) > 50
+    assert np.array_equal(cells, bfs_covering(loop))
+    assert dt < 5.0, f"sliver covering took {dt:.1f}s"
