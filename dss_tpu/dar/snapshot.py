@@ -44,6 +44,7 @@ import numpy as np
 from dss_tpu.dar.oracle import Record
 from dss_tpu.dar.pack import pack_records, pow2_at_least
 from dss_tpu.ops.conflict import NO_TIME_HI, NO_TIME_LO
+from dss_tpu.ops import fastpath
 from dss_tpu.ops.fastpath import FastTable
 
 
@@ -122,9 +123,7 @@ def _overlay_search(
     total = int(n.sum())
     if total == 0:
         return np.zeros(0, np.int64), np.zeros(0, np.int64)
-    base = np.repeat(lo, n)
-    intra = np.arange(total) - np.repeat(np.cumsum(n) - n, n)
-    cand = ov.ent[base + intra]
+    cand = ov.ent[np.repeat(lo, n) + fastpath.segmented_arange(n)]
     cq = np.repeat(flat_q, n)
     keep = (
         (ov.alt_hi[cand] >= alt_lo[cq])
@@ -315,9 +314,19 @@ class DarTable:
             qkeys[i, : len(u)] = u
 
         if st.snap.fast is not None:
-            qidx, slots = st.snap.fast.query_fused(
-                qkeys, alt_lo, alt_hi, t_start, t_end, now=now_arr
-            )
+            # small batches answer from the host postings copy (exact,
+            # ~100 us) instead of paying a device round trip; big
+            # batches amortize the trip and win on the device
+            ranges = st.snap.fast.host_candidates(qkeys)
+            if ranges is not None:
+                qidx, slots = st.snap.fast.query_host(
+                    qkeys, alt_lo, alt_hi, t_start, t_end,
+                    now=now_arr, ranges=ranges,
+                )
+            else:
+                qidx, slots = st.snap.fast.query_fused(
+                    qkeys, alt_lo, alt_hi, t_start, t_end, now=now_arr
+                )
             if len(qidx):
                 if st.dead:
                     keep = ~np.isin(

@@ -53,6 +53,14 @@ INT32_MIN = np.int32(-(2**31))
 BLOCK = 128  # postings per block == TPU lane width
 
 
+def segmented_arange(counts: np.ndarray) -> np.ndarray:
+    """Ragged expansion: for counts [2, 3] -> [0, 1, 0, 1, 2].  The
+    cumsum-minus-repeat idiom, factored once (off-by-one prone)."""
+    counts = np.asarray(counts)
+    total = int(counts.sum())
+    return np.arange(total) - np.repeat(np.cumsum(counts) - counts, counts)
+
+
 def pow2_bucket(n: int, lo: int = 256) -> int:
     """Smallest power-of-two >= max(n, lo) — the shared shape-bucketing
     rule that keeps XLA executable counts bounded."""
@@ -102,12 +110,13 @@ def _bitpack_weights() -> np.ndarray:
 
 
 def warmup(device=None) -> None:
-    """Compile the fused kernel's small-query executable ahead of
-    traffic: a tiny table + one single-row query exercises exactly the
-    static shapes a serving-path point lookup uses (batch bucket 16,
-    window bucket 256, word bucket 2^16), so the first real request
-    after boot doesn't pay the multi-second XLA compile against its
-    deadline.  Servers call this from a background thread at startup."""
+    """Compile the fused kernel's small-burst executable ahead of
+    traffic.  Point lookups (batch <= HOST_MAX_BATCH) answer from the
+    host postings copy and never touch the device, so this warms the
+    FIRST device shape a coalesced burst beyond that threshold hits
+    (batch bucket 128, window bucket 256, word bucket 2^16) — the
+    multi-second XLA compile stays off request deadlines.  Servers
+    call this from a background thread at startup."""
     n = BLOCK
     keys = np.arange(n, dtype=np.int32)
     ft = FastTable(
@@ -127,13 +136,16 @@ def warmup(device=None) -> None:
         ),
         device=device,
     )
-    qk = np.arange(8, dtype=np.int32)[None, :]
+    b = FastTable.HOST_MAX_BATCH + 1  # first device-path batch bucket
+    qk = np.broadcast_to(
+        np.arange(8, dtype=np.int32)[None, :], (b, 8)
+    ).copy()
     ft.query_fused(
         qk,
-        np.zeros(1, np.float32),
-        np.ones(1, np.float32),
-        np.zeros(1, np.int64),
-        np.ones(1, np.int64),
+        np.zeros(b, np.float32),
+        np.ones(b, np.float32),
+        np.zeros(b, np.int64),
+        np.ones(b, np.int64),
         now=1,
     )
 
@@ -198,6 +210,7 @@ class FastTable:
         self.n_blocks = nb
         self.host_key = np.asarray(post_key)
         self.host_ent = np.asarray(post_ent)
+        self.host_live = np.asarray(live, bool)
         self.bitpack_w = jax.device_put(_bitpack_weights(), device)
         self._device = device
 
@@ -433,10 +446,7 @@ class FastTable:
         win_q = np.repeat(flat_q, n_blocks).astype(np.int32)
         win_key = np.repeat(flat_k, n_blocks)
         starts = np.repeat(first_blk, n_blocks)
-        intra = np.arange(len(win_q)) - np.repeat(
-            np.cumsum(n_blocks) - n_blocks, n_blocks
-        )
-        win_blk = (starts + intra).astype(np.int32)
+        win_blk = (starts + segmented_arange(n_blocks)).astype(np.int32)
         blk0 = win_blk.astype(np.int64) * BLOCK
         win_start = np.maximum(np.repeat(lo, n_blocks) - blk0, 0).astype(np.int32)
         win_end = np.minimum(np.repeat(hi, n_blocks) - blk0, BLOCK).astype(np.int32)
@@ -588,6 +598,65 @@ class FastTable:
                 now=now, max_words=max_words,
             )
         )
+
+    # -- host small-batch path ----------------------------------------------
+
+    # route small batches to the host when the candidate postings fit
+    # comfortably in cache: a point lookup then costs ~100 us of numpy
+    # instead of a device round trip (which, tunneled, is ~100 ms) —
+    # the <5 ms p50 leg of the north star.  Large batches amortize the
+    # round trip and win on the device.
+    HOST_MAX_BATCH = 64
+    HOST_MAX_CANDIDATES = 1 << 16
+
+    def host_candidates(self, qkeys: np.ndarray):
+        """-> (lo, hi) postings ranges for the batch, or None when the
+        batch should go to the device (too big).  Thread-safe: ranges
+        are returned, not cached (readers are lock-free)."""
+        if len(qkeys) > self.HOST_MAX_BATCH or self.slot_exact is None:
+            return None
+        lo, hi = self._range_lookup(
+            np.ascontiguousarray(qkeys, np.int32).ravel()
+        )
+        if int((hi - lo).sum()) > self.HOST_MAX_CANDIDATES:
+            return None
+        return lo, hi
+
+    def query_host(
+        self, qkeys, alt_lo, alt_hi, t_start, t_end, *, now, ranges,
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Exact small-batch query on the host postings + exact
+        columns: identical semantics (and results) to query_fused.
+        `ranges` comes from host_candidates()."""
+        B, W = qkeys.shape
+        lo, hi = ranges
+        n = hi - lo
+        nonempty = n > 0
+        lo_n, n_n = lo[nonempty], n[nonempty]
+        flat_q = np.repeat(np.arange(B), W)[nonempty]
+        total = int(n_n.sum())
+        if total == 0:
+            return np.zeros(0, np.int64), np.zeros(0, np.int64)
+        offs = np.repeat(lo_n, n_n) + segmented_arange(n_n)
+        slots = self.host_ent[offs]
+        qidx = np.repeat(flat_q, n_n)
+        se = self.slot_exact
+        now_q = np.asarray(now, np.int64)
+        if now_q.ndim:
+            now_q = now_q[qidx]
+        alt_lo = np.asarray(alt_lo, np.float32)
+        alt_hi = np.asarray(alt_hi, np.float32)
+        t_start = np.asarray(t_start, np.int64)
+        t_end = np.asarray(t_end, np.int64)
+        keep = (
+            self.host_live[offs]  # per-posting build-time tombstones
+            & se["live"][slots]  # per-slot post-build tombstones
+            & (se["alt_hi"][slots] >= alt_lo[qidx])
+            & (se["alt_lo"][slots] <= alt_hi[qidx])
+            & (se["t1"][slots] >= np.maximum(t_start[qidx], now_q))
+            & (se["t0"][slots] <= t_end[qidx])
+        )
+        return qidx[keep].astype(np.int64), slots[keep].astype(np.int64)
 
     # -- the full query pipeline ---------------------------------------------
 

@@ -279,3 +279,53 @@ def test_fastpath_tombstones_and_subsecond_edges():
     assert 0 not in slots.tolist()
     # tombstoned slot 3 never appears
     assert 3 not in slots.tolist()
+
+
+def test_query_host_matches_fused():
+    """The host small-batch path must return exactly the fused device
+    path's (qidx, slot) set — same data, same semantics — including
+    per-posting build tombstones and per-slot mark_dead."""
+    rng = np.random.default_rng(3)
+    n_ent, n_cells, kpe = 3000, 400, 6
+    pk = rng.integers(0, n_cells, n_ent * kpe).astype(np.int32)
+    pe = np.repeat(np.arange(n_ent, dtype=np.int32), kpe)
+    order = np.argsort(pk, kind="stable")
+    pk, pe = pk[order], pe[order]
+    alt_lo = rng.uniform(0, 1000, n_ent).astype(np.float32)
+    alt_hi = alt_lo + rng.uniform(5, 200, n_ent).astype(np.float32)
+    t0 = rng.integers(0, 10**6, n_ent).astype(np.int64)
+    t1 = t0 + rng.integers(1, 10**6, n_ent).astype(np.int64)
+    live_post = rng.random(len(pe)) > 0.05  # some build tombstones
+    ft = FastTable(
+        pk, pe, alt_lo[pe], alt_hi[pe], t0[pe], t1[pe], live_post,
+        slot_exact=dict(
+            alt_lo=alt_lo, alt_hi=alt_hi, t0=t0, t1=t1,
+            live=np.ones(n_ent, bool),
+        ),
+    )
+    for s in rng.integers(0, n_ent, 50):
+        ft.mark_dead(int(s))  # some post-build tombstones
+
+    for trial in range(8):
+        b = int(rng.integers(1, 8))
+        qkeys = np.full((b, 8), -1, np.int32)
+        for i in range(b):
+            w = int(rng.integers(1, 8))
+            qkeys[i, :w] = rng.integers(0, n_cells, w)
+        alo = rng.uniform(0, 1000, b).astype(np.float32)
+        ahi = (alo + 150).astype(np.float32)
+        ts = rng.integers(0, 10**6, b).astype(np.int64)
+        te = ts + rng.integers(1, 10**6, b).astype(np.int64)
+        now = int(rng.integers(0, 10**6))
+
+        ranges = ft.host_candidates(qkeys)
+        assert ranges is not None
+        hq, hs = ft.query_host(
+            qkeys, alo, ahi, ts, te, now=now, ranges=ranges
+        )
+        fq, fs = ft.query_fused(qkeys, alo, ahi, ts, te, now=now)
+        host_set = set(zip(hq.tolist(), hs.tolist()))
+        fused_set = set(zip(fq.tolist(), fs.tolist()))
+        assert host_set == fused_set, (
+            trial, len(host_set ^ fused_set)
+        )
