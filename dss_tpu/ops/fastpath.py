@@ -113,10 +113,10 @@ def warmup(device=None) -> None:
     """Compile the fused kernel's small-burst executable ahead of
     traffic.  Point lookups (batch <= HOST_MAX_BATCH) answer from the
     host postings copy and never touch the device, so this warms the
-    FIRST device shape a coalesced burst beyond that threshold hits
-    (batch bucket 128, window bucket 256, word bucket 2^16) — the
-    multi-second XLA compile stays off request deadlines.  Servers
-    call this from a background thread at startup."""
+    FIRST device shapes a coalesced burst beyond that threshold hits
+    (batch bucket 128; window buckets 256 and 1024; word bucket 2^16)
+    — the multi-second XLA compiles stay off request deadlines.
+    Servers call this from a background thread at startup."""
     n = BLOCK
     keys = np.arange(n, dtype=np.int32)
     ft = FastTable(
@@ -137,17 +137,21 @@ def warmup(device=None) -> None:
         device=device,
     )
     b = FastTable.HOST_MAX_BATCH + 1  # first device-path batch bucket
-    qk = np.broadcast_to(
-        np.arange(8, dtype=np.int32)[None, :], (b, 8)
-    ).copy()
-    ft.query_fused(
-        qk,
-        np.zeros(b, np.float32),
-        np.ones(b, np.float32),
-        np.zeros(b, np.int64),
-        np.ones(b, np.int64),
-        now=1,
-    )
+    # warm the two window buckets such a burst lands in: b point-ish
+    # queries (3 keys -> nw <= 195 -> bucket 256) and b full coverings
+    # (8 keys -> nw ~ 520 -> bucket 1024)
+    for width in (3, 8):
+        qk = np.broadcast_to(
+            np.arange(width, dtype=np.int32)[None, :], (b, width)
+        ).copy()
+        ft.query_fused(
+            qk,
+            np.zeros(b, np.float32),
+            np.ones(b, np.float32),
+            np.zeros(b, np.int64),
+            np.ones(b, np.int64),
+            now=1,
+        )
 
 
 class PendingBatch:

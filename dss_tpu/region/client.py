@@ -59,8 +59,9 @@ class RegionClient:
                 f"malformed region response ({what}): {e!r}"
             ) from e
 
-    def acquire_lease(self) -> int:
-        """Blocking acquire with backoff; -> fencing token."""
+    def acquire_lease(self) -> Tuple[int, Optional[int]]:
+        """Blocking acquire with backoff; -> (fencing token, log head
+        as of the grant — None from a pre-head server)."""
         deadline = time.monotonic() + self.acquire_timeout_s
         delay = 0.005
         while True:
@@ -76,7 +77,12 @@ class RegionClient:
             except requests.RequestException as e:
                 raise RegionError(f"region log unreachable: {e}") from e
             if r.status_code == 200:
-                return self._field(self._json(r), "token", int, "lease")
+                body = self._json(r)
+                head = body.get("head")
+                return (
+                    self._field(body, "token", int, "lease"),
+                    None if head is None else int(head),
+                )
             if r.status_code == 401:
                 raise RegionError("region auth rejected (bad token)")
             if time.monotonic() >= deadline:
@@ -97,21 +103,34 @@ class RegionClient:
         except requests.RequestException:
             pass  # lease expires on its own TTL
 
-    def append(self, token: int, records: List[dict]) -> int:
+    def append(
+        self, token: int, records: List[dict], *, release: bool = False
+    ) -> int:
         """Append one entry (this txn's whole batch) -> its entry
-        index.  Raises RegionError if the lease was fenced (caller must
-        resync)."""
+        index.  release=True drops the lease in the same round trip.
+        Raises RegionError if the lease was fenced (caller must
+        converge via rollback + tail)."""
         try:
             r = self._session.post(
                 f"{self.base}/append",
-                json={"token": token, "records": records},
+                json={
+                    "token": token,
+                    "records": records,
+                    "release": release,
+                },
                 timeout=self._timeout,
             )
         except requests.RequestException as e:
             raise RegionError(f"region append failed: {e}") from e
         if r.status_code != 200:
             raise RegionError(f"region append fenced: {r.text}")
-        return self._field(self._json(r), "index", int, "append")
+        body = self._json(r)
+        idx = self._field(body, "index", int, "append")
+        if release and not body.get("released"):
+            # older server ignored the piggyback flag: release
+            # explicitly so the lease doesn't leak for its full TTL
+            self.release_lease(token)
+        return idx
 
     def fetch(
         self, from_index: int

@@ -158,12 +158,18 @@ class RegionCoordinator:
                     raise errors.unavailable(f"region resync: {e}")
 
             try:
-                token = self._client.acquire_lease()
+                token, head = self._client.acquire_lease()
             except RegionError as e:
                 raise errors.unavailable(f"region write lease: {e}")
+            released = False
             try:
                 try:
-                    self._catch_up_locked()
+                    if head is None or head > self._applied:
+                        # behind the log: fetch + apply the gap.  When
+                        # the grant says we're current, skip the fetch
+                        # round trip entirely (the lease guarantees
+                        # nothing lands meanwhile).
+                        self._catch_up_locked()
                 except RegionError as e:
                     raise errors.unavailable(f"region catch-up: {e}")
                 self._depth = 1
@@ -180,9 +186,12 @@ class RegionCoordinator:
                     buf, self._buffer = self._buffer, None
                     self._depth = 0
                 if buf:
+                    # append + release in one round trip
                     self._commit_locked(token, buf)
+                    released = True
             finally:
-                self._client.release_lease(token)
+                if not released:
+                    self._client.release_lease(token)
 
     def _commit_locked(self, token: int, buf: List[dict]) -> None:
         # "undo" lists are local rollback state, not region history
@@ -190,7 +199,7 @@ class RegionCoordinator:
             {k: v for k, v in rec.items() if k != "undo"} for rec in buf
         ]
         try:
-            idx = self._client.append(token, wire)
+            idx = self._client.append(token, wire, release=True)
         except RegionError as e:
             # Fenced (definite no-append) or network error (append
             # MAY have landed): either way, undo the local mutations —

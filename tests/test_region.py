@@ -398,11 +398,11 @@ def test_fenced_append_resyncs_and_recovers(region):
     real_append = coord._client.append
     calls = {"n": 0}
 
-    def flaky_append(token, records):
+    def flaky_append(token, records, **kw):
         calls["n"] += 1
         if calls["n"] == 1:
             raise RegionError("simulated fence: lease lost")
-        return real_append(token, records)
+        return real_append(token, records, **kw)
 
     coord._client.append = flaky_append
     isa_id = str(uuid.uuid4())
@@ -576,14 +576,13 @@ def test_snapshot_compaction_bounds_late_join(region):
     n_entries, per = 200, 50
     made = []
     for e in range(n_entries):
-        token = client.acquire_lease()
+        token, _head = client.acquire_lease()
         recs = []
         for i in range(per):
             doc = dict(template, id=str(uuid.uuid4()))
             made.append(doc["id"])
             recs.append({"t": "isa_put", "doc": doc})
-        client.append(token, recs)
-        client.release_lease(token)
+        client.append(token, recs, release=True)
 
     # the live instance tails up to head, then uploads a snapshot and
     # the log compacts below it
