@@ -264,6 +264,7 @@ def serving_leg(table, n_cells, width, threads, warm_s, run_s):
         "p99_ms": float(all_lats[int(len(all_lats) * 0.99)] * 1000),
         "threads": threads,
         "samples": int(len(all_lats)),
+        "host_cpus": os.cpu_count(),
     }
 
 

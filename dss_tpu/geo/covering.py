@@ -60,9 +60,24 @@ class BadAreaError(Exception):
 # ---------------------------------------------------------------------------
 
 
+def _cross3(a, b):
+    """Manual cross product: identical math to np.cross but without its
+    ~50us call overhead (the covering's predicates run on tiny arrays
+    where that overhead dominates).  Supports (..., 3) broadcasting."""
+    a = np.asarray(a)
+    b = np.asarray(b)
+    out = np.empty(np.broadcast_shapes(a.shape, b.shape), dtype=np.float64)
+    a0, a1, a2 = a[..., 0], a[..., 1], a[..., 2]
+    b0, b1, b2 = b[..., 0], b[..., 1], b[..., 2]
+    out[..., 0] = a1 * b2 - a2 * b1
+    out[..., 1] = a2 * b0 - a0 * b2
+    out[..., 2] = a0 * b1 - a1 * b0
+    return out
+
+
 def _sign(a, b, c):
     """Sign of det(a, b, c): +1 if c is left of a->b (CCW), else -1/0."""
-    d = np.dot(np.cross(a, b), c)
+    d = np.dot(_cross3(a, b), c)
     if d > 0:
         return 1
     if d < 0:
@@ -91,9 +106,9 @@ def _edges_cross(a, b, c, d):
     point.  Computes the great-circle intersection and checks it lies
     strictly within both arcs (robust for long arcs, unlike pure
     side-of-plane tests)."""
-    n1 = np.cross(a, b)
-    n2 = np.cross(c, d)
-    x = np.cross(n1, n2)
+    n1 = _cross3(a, b)
+    n2 = _cross3(c, d)
+    x = _cross3(n1, n2)
     norm = np.linalg.norm(x)
     if norm < 1e-30:
         return False  # coplanar / degenerate
@@ -139,7 +154,7 @@ def _ortho(p):
     k = int(np.argmin(np.abs(p)))
     axis = np.zeros(3)
     axis[k] = 1.0
-    o = np.cross(p, axis)
+    o = _cross3(p, axis)
     return o / np.linalg.norm(o)
 
 
@@ -206,7 +221,7 @@ class Loop:
         v0 = self.v[0]
         for k in range(1, self.n - 1):
             a, b, c = v0, self.v[k], self.v[k + 1]
-            triple = np.dot(np.cross(a, b), c)
+            triple = np.dot(_cross3(a, b), c)
             denom = 1.0 + np.dot(a, b) + np.dot(b, c) + np.dot(c, a)
             total += 2.0 * math.atan2(triple, denom)
         return total
@@ -300,9 +315,9 @@ def _arcs_cross_many(a, b, c, d):
     b = np.atleast_2d(b)
     c = np.atleast_2d(c)
     d = np.atleast_2d(d)
-    n1 = np.cross(a, b)  # (K, 3)
-    n2 = np.cross(c, d)  # (J, 3)
-    x = np.cross(n1[:, None, :], n2[None, :, :])  # (K, J, 3)
+    n1 = _cross3(a, b)  # (K, 3)
+    n2 = _cross3(c, d)  # (J, 3)
+    x = _cross3(n1[:, None, :], n2[None, :, :])  # (K, J, 3)
     norm = np.linalg.norm(x, axis=-1)
     ok = norm >= 1e-30
     with np.errstate(divide="ignore", invalid="ignore"):
@@ -597,7 +612,7 @@ def covering_circle(lat, lng, radius_meter) -> np.ndarray:
     # regular loop: 20 vertices CCW around center at the given angular radius
     z = center
     x = _ortho(z)
-    y = np.cross(z, x)
+    y = _cross3(z, x)
     y /= np.linalg.norm(y)
     cos_r = math.cos(radius_angle)
     sin_r = math.sin(radius_angle)
