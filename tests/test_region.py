@@ -723,3 +723,64 @@ def test_resync_failure_keeps_serving_old_state(region):
         "uss1",
     )
     assert store.rid.get_isa(isa_id) is not None
+
+
+def test_concurrent_writers_across_instances_converge(region):
+    """Parallel writers on all three instances: the lease serializes
+    every write (including the piggybacked-release fast path), nothing
+    deadlocks, and all instances converge to the identical entity set.
+    The reference gets this from CRDB txns; here it pins the
+    acquire(head)/append(release) protocol under real contention."""
+    server, stores = region
+    services = [RIDService(s.rid, s.clock) for s in stores]
+    per_thread = 4
+    threads_per_instance = 3
+    created = []
+    created_mu = threading.Lock()
+    failures = []
+
+    def writer(svc_i, t_i):
+        for k in range(per_thread):
+            isa_id = str(uuid.uuid4())
+            try:
+                services[svc_i].create_isa(
+                    isa_id,
+                    {
+                        "extents": rid_extents(
+                            lat=37.03 + 0.001 * (svc_i * 10 + t_i)
+                        ),
+                        "flights_url": "https://u.example/f",
+                    },
+                    f"uss{svc_i}",
+                )
+                with created_mu:
+                    created.append(isa_id)
+            except Exception as e:  # noqa: BLE001 — collect, don't die
+                failures.append((svc_i, t_i, k, repr(e)))
+
+    ths = [
+        threading.Thread(target=writer, args=(si, ti), daemon=True)
+        for si in range(3)
+        for ti in range(threads_per_instance)
+    ]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join(timeout=60)
+    assert not any(t.is_alive() for t in ths), "a writer deadlocked"
+    assert not failures, failures[:3]
+    assert len(created) == 3 * threads_per_instance * per_thread
+
+    # every instance converges to the full set
+    def all_visible(store):
+        return (
+            all(store.rid.get_isa(i) is not None for i in created) or None
+        )
+
+    for s in stores:
+        wait_until(lambda s=s: all_visible(s), deadline_s=10)
+    # and every instance lands on the same applied log index
+    wait_until(
+        lambda: (len({st.region.applied for st in stores}) == 1) or None,
+        deadline_s=10,
+    )
