@@ -24,6 +24,7 @@ with spherical cell/loop intersection tests.
 
 from __future__ import annotations
 
+import functools
 import math
 
 import numpy as np
@@ -629,7 +630,23 @@ def covering_circle(lat, lng, radius_meter) -> np.ndarray:
 
 def area_to_cell_ids(area: str) -> np.ndarray:
     """Parse 'lat0,lng0,lat1,lng1,...' and return its covering
-    (pkg/geo/s2.go:124-166)."""
+    (pkg/geo/s2.go:124-166).
+
+    Memoized (LRU 1024): USS monitoring traffic polls the same
+    operating areas over and over, and the covering is a pure function
+    of the string.  Cached arrays are returned read-only (shared across
+    callers); parse/area failures are not cached."""
+    return _area_to_cell_ids_cached(area)
+
+
+@functools.lru_cache(maxsize=1024)
+def _area_to_cell_ids_cached(area: str) -> np.ndarray:
+    cells = _area_to_cell_ids_impl(area)
+    cells.setflags(write=False)
+    return cells
+
+
+def _area_to_cell_ids_impl(area: str) -> np.ndarray:
     parts = area.split(",") if area else []
     if len(parts) % 2 == 1:
         raise BadAreaError("odd number of coordinates in area string")

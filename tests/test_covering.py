@@ -144,3 +144,19 @@ def test_validate_cell():
     c12 = s2cell.cell_parent(c13, 12)
     with pytest.raises(BadAreaError):
         covering.validate_cell(c12)
+
+
+def test_area_to_cell_ids_memoized_and_read_only():
+    """Repeated identical area strings hit the cache (same frozen
+    array object); failures are never cached; results are immutable."""
+    area = "40.31,-100.31,40.33,-100.31,40.33,-100.29,40.31,-100.29"
+    c1 = area_to_cell_ids(area)
+    c2 = area_to_cell_ids(area)
+    assert c1 is c2  # cache hit returns the shared object
+    assert not c2.flags.writeable
+    with pytest.raises(ValueError):
+        c2[0] = 0  # callers cannot mutate the shared covering
+    # failures raise every time (not cached as results)
+    for _ in range(2):
+        with pytest.raises(BadAreaError):
+            area_to_cell_ids("1,2,3")
