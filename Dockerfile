@@ -11,6 +11,12 @@ COPY pyproject.toml README.md ./
 COPY dss_tpu ./dss_tpu
 RUN pip install --no-cache-dir . ${JAX_EXTRA}
 
+# build info (the reference's -ldflags -X injection, pkg/build) — after
+# the install layers so a changing commit never busts the pip cache
+ARG BUILD_COMMIT=unknown
+ARG BUILD_TIME=unknown
+ENV DSS_BUILD_COMMIT=${BUILD_COMMIT} DSS_BUILD_TIME=${BUILD_TIME}
+
 # flags mirror cmds/grpc-backend (see dss_tpu/cmds/server.py --help)
 EXPOSE 8082
 ENTRYPOINT ["dss-server"]

@@ -169,6 +169,9 @@ def build(args) -> web.Application:
 
     configure_logging()
     log = get_logger("dss.server")
+    from dss_tpu.build_info import build_info
+
+    log.info("build: %s", build_info())
     if args.virtual_cpu_devices:
         # must land before the first backend initialization; config
         # update (not env) because the environment may force-rewrite
@@ -259,6 +262,7 @@ def build(args) -> web.Application:
         )
 
     metrics = MetricsRegistry()
+    metrics.set_info("dss_build_info", build_info())
 
     replica = None
     if args.sharded_replica:

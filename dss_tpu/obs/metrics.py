@@ -50,6 +50,7 @@ class MetricsRegistry:
         self._hist_sum: Dict[Tuple[str, str], float] = {}
         self._hist_cnt: Dict[Tuple[str, str], int] = {}
         self._gauges: Dict[str, float] = {}
+        self._infos: Dict[str, Dict[str, str]] = {}
 
     def observe_request(
         self, method: str, path: str, status: int, duration_s: float
@@ -73,10 +74,22 @@ class MetricsRegistry:
         with self._lock:
             self._gauges[name] = float(value)
 
+    def set_info(self, name: str, labels: Dict[str, str]) -> None:
+        """Prometheus info-pattern gauge: <name>{k="v",...} 1 (e.g.
+        dss_build_info with commit/host labels)."""
+        with self._lock:
+            self._infos[name] = dict(labels)
+
     def render(self) -> str:
         """Prometheus text exposition format."""
         lines = []
         with self._lock:
+            for name, labels in sorted(self._infos.items()):
+                lab = ",".join(
+                    f'{k}="{v}"' for k, v in sorted(labels.items())
+                )
+                lines.append(f"# TYPE {name} gauge")
+                lines.append(f"{name}{{{lab}}} 1")
             lines.append("# TYPE dss_requests_total counter")
             for (m, r, s), v in sorted(self._counters.items()):
                 lines.append(

@@ -1,0 +1,29 @@
+"""MetricsRegistry exposition (RED metrics + gauges + info pattern)."""
+
+from __future__ import annotations
+
+from dss_tpu.obs.metrics import MetricsRegistry
+
+
+def test_render_counters_gauges_and_info():
+    m = MetricsRegistry()
+    isa_id = "dddddddd-dddd-4ddd-8ddd-ddddddddddd1"
+    path = f"/v1/dss/identification_service_areas/{isa_id}"
+    m.observe_request("GET", path, 200, 0.012)
+    m.observe_request("GET", path, 200, 0.5)
+    m.set_gauge("dss_dar_op_live_records", 42)
+    m.set_info("dss_build_info", {"commit": "deadbeef", "host": "unit"})
+    text = m.render()
+    assert 'dss_build_info{commit="deadbeef",host="unit"} 1' in text
+    assert "dss_requests_total" in text and 'status="200"' in text
+    assert "dss_dar_op_live_records 42" in text
+    # route templating: the UUID segment must not mint a label series
+    assert isa_id not in text
+
+
+def test_info_overwrites_not_accumulates():
+    m = MetricsRegistry()
+    m.set_info("dss_build_info", {"commit": "a"})
+    m.set_info("dss_build_info", {"commit": "b"})
+    text = m.render()
+    assert 'commit="b"' in text and 'commit="a"' not in text
