@@ -19,15 +19,22 @@ def build_info() -> dict:
     commit = os.environ.get("DSS_BUILD_COMMIT", "")
     built_at = os.environ.get("DSS_BUILD_TIME", "")
     if not commit:
-        try:
-            commit = subprocess.run(
-                ["git", "rev-parse", "--short", "HEAD"],
-                cwd=os.path.dirname(os.path.abspath(__file__)),
-                capture_output=True,
-                text=True,
-                timeout=5,
-            ).stdout.strip() or "unknown"
-        except (OSError, subprocess.SubprocessError):
+        # dev-checkout fallback only: the .git must sit right next to
+        # the package, or `git rev-parse` would walk up and report
+        # whatever unrelated repo encloses a pip-installed venv
+        pkg_dir = os.path.dirname(os.path.abspath(__file__))
+        repo_root = os.path.dirname(pkg_dir)
+        if os.path.isdir(os.path.join(repo_root, ".git")):
+            try:
+                commit = subprocess.run(
+                    ["git", "-C", repo_root, "rev-parse", "--short", "HEAD"],
+                    capture_output=True,
+                    text=True,
+                    timeout=5,
+                ).stdout.strip() or "unknown"
+            except (OSError, subprocess.SubprocessError):
+                commit = "unknown"
+        else:
             commit = "unknown"
     return {
         "commit": commit,

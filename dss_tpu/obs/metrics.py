@@ -84,9 +84,19 @@ class MetricsRegistry:
         """Prometheus text exposition format."""
         lines = []
         with self._lock:
+            def esc(v: str) -> str:
+                # Prometheus exposition label escaping: one bad value
+                # must not invalidate the whole scrape
+                return (
+                    str(v)
+                    .replace("\\", "\\\\")
+                    .replace('"', '\\"')
+                    .replace("\n", "\\n")
+                )
+
             for name, labels in sorted(self._infos.items()):
                 lab = ",".join(
-                    f'{k}="{v}"' for k, v in sorted(labels.items())
+                    f'{k}="{esc(v)}"' for k, v in sorted(labels.items())
                 )
                 lines.append(f"# TYPE {name} gauge")
                 lines.append(f"{name}{{{lab}}} 1")
