@@ -24,7 +24,7 @@ def build_info() -> dict:
         # whatever unrelated repo encloses a pip-installed venv
         pkg_dir = os.path.dirname(os.path.abspath(__file__))
         repo_root = os.path.dirname(pkg_dir)
-        if os.path.isdir(os.path.join(repo_root, ".git")):
+        if os.path.exists(os.path.join(repo_root, ".git")):  # dir or worktree file
             try:
                 commit = subprocess.run(
                     ["git", "-C", repo_root, "rev-parse", "--short", "HEAD"],

@@ -42,6 +42,17 @@ def route_template(path: str) -> str:
     return "/".join(out)
 
 
+def _esc_label(v) -> str:
+    """Prometheus exposition-format label escaping (backslash, quote,
+    newline)."""
+    return (
+        str(v)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
 class MetricsRegistry:
     def __init__(self):
         self._lock = threading.Lock()
@@ -81,37 +92,33 @@ class MetricsRegistry:
             self._infos[name] = dict(labels)
 
     def render(self) -> str:
-        """Prometheus text exposition format."""
+        """Prometheus text exposition format.  Every label value is
+        escaped: route labels come from request paths (remotely
+        supplied), and one bad value must not invalidate the whole
+        scrape."""
         lines = []
         with self._lock:
-            def esc(v: str) -> str:
-                # Prometheus exposition label escaping: one bad value
-                # must not invalidate the whole scrape
-                return (
-                    str(v)
-                    .replace("\\", "\\\\")
-                    .replace('"', '\\"')
-                    .replace("\n", "\\n")
-                )
-
             for name, labels in sorted(self._infos.items()):
                 lab = ",".join(
-                    f'{k}="{esc(v)}"' for k, v in sorted(labels.items())
+                    f'{k}="{_esc_label(v)}"' for k, v in sorted(labels.items())
                 )
                 lines.append(f"# TYPE {name} gauge")
                 lines.append(f"{name}{{{lab}}} 1")
             lines.append("# TYPE dss_requests_total counter")
             for (m, r, s), v in sorted(self._counters.items()):
                 lines.append(
-                    f'dss_requests_total{{method="{m}",route="{r}",'
-                    f'status="{s}"}} {v}'
+                    f'dss_requests_total{{method="{_esc_label(m)}",'
+                    f'route="{_esc_label(r)}",status="{s}"}} {v}'
                 )
             lines.append(
                 "# TYPE dss_request_duration_seconds histogram"
             )
             for hk in sorted(self._hist):
                 m, r = hk
-                lab = f'method="{m}",route="{r}"'
+                lab = (
+                    f'method="{_esc_label(m)}",route="{_esc_label(r)}"'
+                )
+
                 cum = 0
                 for i, b in enumerate(BUCKETS):
                     cum = self._hist[hk][i]

@@ -1,0 +1,227 @@
+"""More prober-parity black-box scenarios against the live binaries.
+
+Ports of the remaining reference prober files to the REST surface:
+  - monitoring/prober/rid/test_token_validation.py (DSS0010 auth)
+  - monitoring/prober/rid/test_subscription_simple.py
+  - monitoring/prober/rid/test_isa_validation.py
+  - monitoring/prober/scd/test_subscription_simple.py
+"""
+
+from __future__ import annotations
+
+import uuid
+
+import requests
+
+from tests.e2e.test_blackbox import (
+    RID_SCOPE,
+    SCD_SCOPE,
+    area_str,
+    isa_params,
+    now_iso,
+    scd_extent,
+)
+
+RID_READ = "dss.read.identification_service_areas"
+
+
+def test_token_validation_dss0010(stack):
+    """DSS0010: no token, undecodable token, wrong-scope writes."""
+    base, oauth = stack["base"], stack["oauth"]
+    isa_id = str(uuid.uuid4())
+    url = f"{base}/v1/dss/identification_service_areas/{isa_id}"
+
+    # no token -> 401
+    assert requests.get(url, timeout=5).status_code == 401
+    # garbage token -> 401
+    r = requests.get(
+        url, headers={"Authorization": "Bearer not.a.jwt"}, timeout=5
+    )
+    assert r.status_code == 401
+    # read-only scope cannot write -> 403
+    r = requests.put(
+        url,
+        json=isa_params(lat=47.1),
+        headers=oauth.hdr(RID_READ),
+        timeout=5,
+    )
+    assert r.status_code == 403
+    # validate_oauth owner mismatch -> 403; match -> 200
+    r = requests.get(
+        f"{base}/aux/v1/validate_oauth",
+        params={"owner": "bad_user"},
+        headers=oauth.hdr(RID_SCOPE, sub="fake_uss"),
+        timeout=5,
+    )
+    assert r.status_code == 403
+    r = requests.get(
+        f"{base}/aux/v1/validate_oauth",
+        params={"owner": "fake_uss"},
+        headers=oauth.hdr(RID_SCOPE, sub="fake_uss"),
+        timeout=5,
+    )
+    assert r.status_code == 200
+
+
+def test_rid_subscription_lifecycle(stack):
+    """prober/rid/test_subscription_simple.py over the wire."""
+    base, oauth = stack["base"], stack["oauth"]
+    h = oauth.hdr(RID_SCOPE, sub="uss-sub")
+    sub_id = str(uuid.uuid4())
+    lat = 47.5
+    url = f"{base}/v1/dss/subscriptions/{sub_id}"
+
+    # does not exist yet
+    assert requests.get(url, headers=h, timeout=5).status_code == 404
+
+    body = {
+        "extents": isa_params(lat=lat)["extents"],
+        "callbacks": {
+            "identification_service_area_url": "https://u.example/isa"
+        },
+    }
+    r = requests.put(url, json=body, headers=h, timeout=5)
+    assert r.status_code == 200, r.text
+    version = r.json()["subscription"]["version"]
+    assert version
+
+    # get by id + by search
+    r = requests.get(url, headers=h, timeout=5)
+    assert r.status_code == 200
+    assert r.json()["subscription"]["version"] == version
+    r = requests.get(
+        f"{base}/v1/dss/subscriptions",
+        params={"area": area_str(lat=lat)},
+        headers=h,
+        timeout=5,
+    )
+    assert r.status_code == 200
+    assert any(
+        s["id"] == sub_id for s in r.json()["subscriptions"]
+    )
+    # huge search area -> 413 (test_get_sub_by_searching_huge_area)
+    huge = "-1,-1,-1,1,1,1,1,-1"
+    r = requests.get(
+        f"{base}/v1/dss/subscriptions",
+        params={"area": huge},
+        headers=h,
+        timeout=5,
+    )
+    assert r.status_code == 413, r.text
+
+    # unparseable version -> 400 (reference prober
+    # test_delete_sub_wrong_version; the reference app otherwise
+    # ignores the supplied version on sub delete —
+    # application/subscription.go:84-100, reproduced)
+    r = requests.delete(f"{url}/fake_version", headers=h, timeout=5)
+    assert r.status_code == 400, r.text
+    r = requests.delete(f"{url}/{version}", headers=h, timeout=5)
+    assert r.status_code == 200, r.text
+    # gone from get + search
+    assert requests.get(url, headers=h, timeout=5).status_code == 404
+    r = requests.get(
+        f"{base}/v1/dss/subscriptions",
+        params={"area": area_str(lat=lat)},
+        headers=h,
+        timeout=5,
+    )
+    assert not any(
+        s["id"] == sub_id for s in r.json()["subscriptions"]
+    )
+
+
+def test_isa_validation_rejections(stack):
+    """prober/rid/test_isa_validation.py: malformed/oversized ISAs."""
+    base, oauth = stack["base"], stack["oauth"]
+    h = oauth.hdr(RID_SCOPE)
+
+    def put(body):
+        return requests.put(
+            f"{base}/v1/dss/identification_service_areas/{uuid.uuid4()}",
+            json=body,
+            headers=h,
+            timeout=5,
+        )
+
+    good = isa_params(lat=48.0)
+
+    # huge area -> 413
+    huge = isa_params(lat=48.0)
+    huge["extents"]["spatial_volume"]["footprint"]["vertices"] = [
+        {"lat": -1.0, "lng": -1.0},
+        {"lat": -1.0, "lng": 1.0},
+        {"lat": 1.0, "lng": 1.0},
+        {"lat": 1.0, "lng": -1.0},
+    ]
+    assert put(huge).status_code == 413
+
+    # empty vertices -> 400
+    bad = isa_params(lat=48.0)
+    bad["extents"]["spatial_volume"]["footprint"]["vertices"] = []
+    assert put(bad).status_code == 400
+
+    # missing footprint -> 400
+    bad = isa_params(lat=48.0)
+    del bad["extents"]["spatial_volume"]["footprint"]
+    assert put(bad).status_code == 400
+
+    # missing extents entirely -> 400
+    assert put({"flights_url": "https://x/f"}).status_code == 400
+
+    # start after end -> 400
+    bad = isa_params(lat=48.0)
+    bad["extents"]["time_start"] = now_iso(3600)
+    bad["extents"]["time_end"] = now_iso(60)
+    assert put(bad).status_code == 400
+
+    # off-earth coordinates -> 400
+    bad = isa_params(lat=48.0)
+    bad["extents"]["spatial_volume"]["footprint"]["vertices"] = [
+        {"lat": 130.0, "lng": 250.0},
+        {"lat": 131.0, "lng": 250.0},
+        {"lat": 131.0, "lng": 251.0},
+    ]
+    assert put(bad).status_code == 400
+
+    # the good one still goes through (the gate rejects, not the stack)
+    assert put(good).status_code == 200
+
+
+def test_scd_subscription_lifecycle(stack):
+    """prober/scd/test_subscription_simple.py over the wire."""
+    base, oauth = stack["base"], stack["oauth"]
+    h = oauth.hdr(SCD_SCOPE, sub="uss-scd-sub")
+    sub_id = str(uuid.uuid4())
+    lat = 48.7
+    url = f"{base}/dss/v1/subscriptions/{sub_id}"
+
+    body = {
+        "extents": scd_extent(lat=lat),
+        "uss_base_url": "https://uss.example.com",
+        "notify_for_operations": True,
+        "notify_for_constraints": False,
+        "old_version": 0,
+    }
+    r = requests.put(url, json=body, headers=h, timeout=5)
+    assert r.status_code == 200, r.text
+    assert r.json()["subscription"]["id"] == sub_id
+
+    r = requests.get(url, headers=h, timeout=5)
+    assert r.status_code == 200
+    assert r.json()["subscription"]["notify_for_operations"] is True
+
+    # query by area
+    r = requests.post(
+        f"{base}/dss/v1/subscriptions/query",
+        json={"area_of_interest": scd_extent(lat=lat)},
+        headers=h,
+        timeout=5,
+    )
+    assert r.status_code == 200, r.text
+    assert any(
+        s["id"] == sub_id for s in r.json()["subscriptions"]
+    )
+
+    r = requests.delete(url, headers=h, timeout=5)
+    assert r.status_code == 200, r.text
+    assert requests.get(url, headers=h, timeout=5).status_code == 404

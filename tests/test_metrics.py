@@ -27,3 +27,17 @@ def test_info_overwrites_not_accumulates():
     m.set_info("dss_build_info", {"commit": "b"})
     text = m.render()
     assert 'commit="b"' in text and 'commit="a"' not in text
+
+
+def test_label_values_escaped_everywhere():
+    """Route labels come from request paths (remotely supplied): a
+    quote/backslash/newline in any label value must be escaped, never
+    break the whole exposition."""
+    m = MetricsRegistry()
+    m.observe_request("GET", '/v1/dss/a"b\\c', 200, 0.01)
+    m.set_info("dss_build_info", {"t": 'x"y\nz'})
+    text = m.render()
+    assert '\\"' in text and "\\n" in text and "\\\\" in text
+    for line in text.splitlines():
+        # balanced quotes on every line (escaped ones excluded)
+        assert line.replace('\\"', "").count('"') % 2 == 0, line
