@@ -1,0 +1,233 @@
+"""Differential fuzz: the tpu and memory backends must be observably
+identical under random operation sequences.
+
+The store-contract tests pin known scenarios; this pins a longer tail:
+random interleavings of ISA create/delete, RID search, SCD operation
+put (with per-backend OVN keys)/delete, and SCD search on BOTH
+backends.  Outcomes (success vs exact error status/code), result-id
+sets, and notified-subscriber sets are compared; versions/OVNs are
+per-store commit-timestamp artifacts and are excluded.  The memory
+backend is a direct transliteration of the reference's SQL semantics
+(dar/oracle.py), so agreement here is agreement with the reference."""
+
+from __future__ import annotations
+
+import uuid
+from datetime import datetime, timedelta, timezone
+
+import numpy as np
+import pytest
+
+from dss_tpu import errors
+from dss_tpu.dar.dss_store import DSSStore
+from dss_tpu.services.rid import RIDService
+from dss_tpu.services.scd import SCDService
+from dss_tpu.services.serialization import format_time
+
+BASE_LAT, BASE_LNG = 40.0, -100.0
+
+
+def _extents(rng):
+    lat = BASE_LAT + float(rng.uniform(0, 0.3))
+    lng = BASE_LNG + float(rng.uniform(0, 0.3))
+    half = float(rng.uniform(0.005, 0.02))
+    now = datetime.now(timezone.utc)
+    t0 = now + timedelta(minutes=int(rng.integers(1, 30)))
+    t1 = t0 + timedelta(minutes=int(rng.integers(10, 120)))
+    return {
+        "spatial_volume": {
+            "footprint": {
+                "vertices": [
+                    {"lat": lat - half, "lng": lng - half},
+                    {"lat": lat - half, "lng": lng + half},
+                    {"lat": lat + half, "lng": lng + half},
+                    {"lat": lat + half, "lng": lng - half},
+                ]
+            },
+            "altitude_lo": float(rng.uniform(0, 200)),
+            "altitude_hi": float(rng.uniform(250, 500)),
+        },
+        "time_start": format_time(t0),
+        "time_end": format_time(t1),
+    }
+
+
+def _search_area(rng):
+    lat = BASE_LAT + float(rng.uniform(0, 0.25))
+    lng = BASE_LNG + float(rng.uniform(0, 0.25))
+    h = float(rng.uniform(0.01, 0.05))
+    return (
+        f"{lat},{lng},{lat + h},{lng},{lat + h},{lng + h},{lat},{lng + h}"
+    )
+
+
+def _norm_outcome(fn, *args):
+    """-> ('ok', normalized-result) or ('err', status, code)."""
+    try:
+        return ("ok", fn(*args))
+    except errors.StatusError as e:
+        return ("err", e.http_status, int(e.code))
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_backends_agree_under_random_ops(seed):
+    stores = {
+        name: DSSStore(storage=name) for name in ("memory", "tpu")
+    }
+    rid = {n: RIDService(s.rid, s.clock) for n, s in stores.items()}
+    scd = {n: SCDService(s.scd, s.clock) for n, s in stores.items()}
+
+    rng = np.random.default_rng(seed)
+    # versions, like OVNs, derive from per-store commit timestamps:
+    # track them per backend and hand each store its own token
+    isa_versions: dict = {n: {} for n in stores}
+    # OVNs are per-store (they derive from each store's commit
+    # timestamps), so each backend presents its OWN keys
+    op_ovns: dict = {n: {} for n in stores}
+
+    for step in range(60):
+        op = rng.integers(0, 6)
+        sid = str(uuid.UUID(int=int(rng.integers(0, 40)), version=4))
+        if op == 0:  # ISA create (fresh id, same for both backends)
+            create_id = (
+                str(uuid.UUID(int=int(rng.integers(1000, 2000)), version=4))
+                if sid in isa_versions["memory"]
+                else sid
+            )
+            body = {"extents": _extents(rng), "flights_url": "https://u/f"}
+            outs = {
+                n: _norm_outcome(rid[n].create_isa, create_id, body, "u1")
+                for n in stores
+            }
+        elif op == 1:  # ISA delete (maybe-existing, maybe-stale version)
+            outs = {
+                n: _norm_outcome(
+                    rid[n].delete_isa,
+                    sid,
+                    isa_versions[n].get(sid, "aaaaaaaaaa"),
+                    "u1",
+                )
+                for n in stores
+            }
+        elif op == 2:  # RID search
+            area = _search_area(rng)
+            outs = {
+                n: _norm_outcome(rid[n].search_isas, area)
+                for n in stores
+            }
+        elif op == 3:  # SCD op put (no key -> may 409-conflict)
+            ext = _extents(rng)  # ONE draw: coherent volume + window
+            body = {
+                "extents": [
+                    {
+                        "volume": {
+                            "outline_polygon": ext["spatial_volume"][
+                                "footprint"
+                            ],
+                            "altitude_lower": {
+                                "value": 50.0, "reference": "W84",
+                                "units": "M",
+                            },
+                            "altitude_upper": {
+                                "value": 200.0, "reference": "W84",
+                                "units": "M",
+                            },
+                        },
+                        "time_start": {
+                            "value": ext["time_start"],
+                            "format": "RFC3339",
+                        },
+                        "time_end": {
+                            "value": ext["time_end"],
+                            "format": "RFC3339",
+                        },
+                    }
+                ],
+                "uss_base_url": "https://u.example",
+                "new_subscription": {"uss_base_url": "https://u.example"},
+                "state": "Accepted",
+                "old_version": 0,
+            }
+            outs = {
+                n: _norm_outcome(
+                    scd[n].put_operation,
+                    sid,
+                    dict(body, key=list(op_ovns[n].values())),
+                    "u1",
+                )
+                for n in stores
+            }
+        elif op == 4:  # SCD op delete
+            outs = {
+                n: _norm_outcome(scd[n].delete_operation, sid, "u1")
+                for n in stores
+            }
+        else:  # SCD search
+            ext = _extents(rng)  # ONE draw: coherent volume + window
+            aoi = {
+                "area_of_interest": {
+                    "volume": {
+                        "outline_polygon": ext["spatial_volume"][
+                            "footprint"
+                        ],
+                    },
+                    "time_start": {
+                        "value": ext["time_start"],
+                        "format": "RFC3339",
+                    },
+                    "time_end": {
+                        "value": ext["time_end"],
+                        "format": "RFC3339",
+                    },
+                }
+            }
+            outs = {
+                n: _norm_outcome(scd[n].search_operations, aoi, "u1")
+                for n in stores
+            }
+
+        mem, tpu = outs["memory"], outs["tpu"]
+        assert mem[0] == tpu[0], (step, op, mem, tpu)
+        if mem[0] == "err":
+            assert mem[1:] == tpu[1:], (step, op, mem, tpu)
+            continue
+        a, b = mem[1], tpu[1]
+        # normalize: versions/OVNs derive from per-store commit
+        # timestamps and legitimately differ; ids and SETS of results
+        # must agree exactly
+        if op == 2:
+            ids_a = sorted(s["id"] for s in a["service_areas"])
+            ids_b = sorted(s["id"] for s in b["service_areas"])
+            assert ids_a == ids_b, (step, ids_a, ids_b)
+        elif op == 5:
+            ids_a = sorted(o["id"] for o in a["operation_references"])
+            ids_b = sorted(o["id"] for o in b["operation_references"])
+            assert ids_a == ids_b, (step, ids_a, ids_b)
+        elif op == 0:
+            subs_a = sorted(
+                x["subscriptions"][0]["subscription_id"]
+                for x in a["subscribers"]
+            )
+            subs_b = sorted(
+                x["subscriptions"][0]["subscription_id"]
+                for x in b["subscribers"]
+            )
+            assert subs_a == subs_b, (step, subs_a, subs_b)
+            isa_versions["memory"][a["service_area"]["id"]] = a[
+                "service_area"
+            ]["version"]
+            isa_versions["tpu"][b["service_area"]["id"]] = b[
+                "service_area"
+            ]["version"]
+        elif op == 1:
+            for m in isa_versions.values():
+                m.pop(sid, None)
+        elif op == 3:
+            op_ovns["memory"][sid] = a["operation_reference"]["ovn"]
+            op_ovns["tpu"][sid] = b["operation_reference"]["ovn"]
+        elif op == 4:
+            for m in op_ovns.values():
+                m.pop(sid, None)
+
+    for s in stores.values():
+        s.close()
