@@ -172,9 +172,20 @@ class RIDStoreImpl(_TxnTimeMixin, RIDStore):
     def serialize_state(self) -> dict:
         """Full-state snapshot as plain JSON docs (region snapshot
         upload; the CRDB-range-snapshot analog)."""
+        return self.serialize_refs(self.snapshot_refs())
+
+    def snapshot_refs(self) -> tuple:
+        """Grab record references for a consistent snapshot cut (cheap;
+        call under the store lock).  Records are immutable — replaced,
+        never mutated — so serialize_refs may run outside the lock."""
+        return (list(self._isas.values()), list(self._subs.values()))
+
+    @staticmethod
+    def serialize_refs(refs: tuple) -> dict:
+        isas, subs = refs
         return {
-            "isas": [codec.isa_to_doc(x) for x in self._isas.values()],
-            "subs": [codec.rid_sub_to_doc(x) for x in self._subs.values()],
+            "isas": [codec.isa_to_doc(x) for x in isas],
+            "subs": [codec.rid_sub_to_doc(x) for x in subs],
         }
 
     def restore_state(self, state: dict) -> None:
@@ -450,9 +461,19 @@ class SCDStoreImpl(_TxnTimeMixin, SCDStore):
     def serialize_state(self) -> dict:
         """Full-state snapshot as plain JSON docs (region snapshot
         upload; the CRDB-range-snapshot analog)."""
+        return self.serialize_refs(self.snapshot_refs())
+
+    def snapshot_refs(self) -> tuple:
+        """Record references for a consistent cut (cheap; call under
+        the store lock); serialize_refs may then run outside it."""
+        return (list(self._ops.values()), list(self._subs.values()))
+
+    @staticmethod
+    def serialize_refs(refs: tuple) -> dict:
+        ops, subs = refs
         return {
-            "ops": [codec.op_to_doc(x) for x in self._ops.values()],
-            "subs": [codec.scd_sub_to_doc(x) for x in self._subs.values()],
+            "ops": [codec.op_to_doc(x) for x in ops],
+            "subs": [codec.scd_sub_to_doc(x) for x in subs],
         }
 
     def restore_state(self, state: dict) -> None:

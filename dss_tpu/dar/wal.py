@@ -58,34 +58,6 @@ class WriteAheadLog:
                     # torn tail write (crash mid-append): stop replay here
                     return
 
-    def rewrite(self, records) -> None:
-        """Atomically replace the log's contents with `records`
-        (compaction): write a temp file, fsync, rename over the old
-        log, reopen for append.  Sequence numbering restarts.  Blocks
-        concurrent appends for the duration — callers who can't afford
-        that should stage a temp file themselves and use adopt()."""
-        if self.path is None:
-            return
-        with self._lock:
-            tmp = f"{self.path}.compact.tmp"
-            seq = 0
-            with open(tmp, "w", encoding="utf-8") as fh:
-                for rec in records:
-                    seq += 1
-                    fh.write(
-                        json.dumps(
-                            dict(rec, seq=seq), separators=(",", ":")
-                        )
-                        + "\n"
-                    )
-                fh.flush()
-                os.fsync(fh.fileno())
-            if self._fh is not None:
-                self._fh.close()
-            os.replace(tmp, self.path)
-            self._seq = seq
-            self._fh = open(self.path, "a", encoding="utf-8")
-
     def adopt(self, tmp_path: str, seq: int) -> None:
         """Swap a fully-written, fsynced replacement log into place:
         rename over the old log and reopen for append.  The caller

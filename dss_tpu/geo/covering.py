@@ -26,6 +26,8 @@ from __future__ import annotations
 
 import functools
 import math
+import threading
+from collections import OrderedDict
 
 import numpy as np
 
@@ -628,21 +630,35 @@ def covering_circle(lat, lng, radius_meter) -> np.ndarray:
     return _loop_covering(loop)
 
 
+_CACHE_MAX_ENTRIES = 1024
+_CACHE_MAX_CELLS_PER_ENTRY = 4096  # bounds worst-case cache to ~32 MB
+_area_cache: "OrderedDict[str, np.ndarray]" = OrderedDict()
+_area_cache_lock = threading.Lock()
+
+
 def area_to_cell_ids(area: str) -> np.ndarray:
     """Parse 'lat0,lng0,lat1,lng1,...' and return its covering
     (pkg/geo/s2.go:124-166).
 
-    Memoized (LRU 1024): USS monitoring traffic polls the same
-    operating areas over and over, and the covering is a pure function
-    of the string.  Cached arrays are returned read-only (shared across
+    Memoized (LRU 1024, small results only): USS monitoring traffic
+    polls the same operating areas over and over, and the covering is a
+    pure function of the string.  Oversized coverings (> a few thousand
+    cells) are never cached so distinct large areas can't pin hundreds
+    of MB.  Cached arrays are returned read-only (shared across
     callers); parse/area failures are not cached."""
-    return _area_to_cell_ids_cached(area)
-
-
-@functools.lru_cache(maxsize=1024)
-def _area_to_cell_ids_cached(area: str) -> np.ndarray:
+    with _area_cache_lock:
+        hit = _area_cache.get(area)
+        if hit is not None:
+            _area_cache.move_to_end(area)
+            return hit
     cells = _area_to_cell_ids_impl(area)
     cells.setflags(write=False)
+    if len(cells) <= _CACHE_MAX_CELLS_PER_ENTRY:
+        with _area_cache_lock:
+            _area_cache[area] = cells
+            _area_cache.move_to_end(area)
+            while len(_area_cache) > _CACHE_MAX_ENTRIES:
+                _area_cache.popitem(last=False)
     return cells
 
 

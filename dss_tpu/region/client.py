@@ -2,6 +2,7 @@
 
 from __future__ import annotations
 
+import json
 import time
 import uuid
 from typing import List, Optional, Tuple
@@ -125,6 +126,11 @@ class RegionClient:
         if r.status_code != 200:
             raise RegionError(f"region append fenced: {r.text}")
         body = self._json(r)
+        if "index" not in body and "from_index" in body:
+            # older server speaks 'from_index'; same mixed-version
+            # tolerance as the 'released' shim below — without it a
+            # committed append would be rolled back and 503'd
+            body = dict(body, index=body["from_index"])
         idx = self._field(body, "index", int, "append")
         if release and not body.get("released"):
             # older server ignored the piggyback flag: release
@@ -184,13 +190,24 @@ class RegionClient:
             self._field(body, "state", dict, "snapshot"),
         )
 
-    def put_snapshot(self, index: int, state: dict) -> bool:
+    def put_snapshot(
+        self, index: int, state: dict = None, *, state_json: str = None
+    ) -> bool:
         """Upload a state snapshot as of entry `index`.  False if the
-        server rejected it as stale (another instance got there first)."""
+        server rejected it as stale (another instance got there first).
+        Pass state_json (pre-serialized) to avoid a second large JSON
+        dump when the caller already serialized for size accounting."""
+        if state_json is not None:
+            body = ('{"index":%d,"state":%s}' % (index, state_json)).encode()
+        else:
+            body = json.dumps(
+                {"index": index, "state": state}, separators=(",", ":")
+            ).encode()
         try:
             r = self._session.post(
                 f"{self.base}/snapshot",
-                json={"index": index, "state": state},
+                data=body,
+                headers={"Content-Type": "application/json"},
                 timeout=max(self._timeout, 30.0),
             )
         except requests.RequestException as e:

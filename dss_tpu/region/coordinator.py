@@ -50,6 +50,7 @@ implementation_details.md:11-42).  One coordinator per DSS instance:
 from __future__ import annotations
 
 import contextlib
+import json
 import logging
 import threading
 import time
@@ -63,6 +64,9 @@ from dss_tpu.region.client import (
 )
 
 log = logging.getLogger("dss.region")
+
+# warn when a snapshot upload nears the log server's 256 MB body cap
+_SNAPSHOT_WARN_BYTES = 192 * 1024 * 1024
 
 
 class RegionCoordinator:
@@ -255,12 +259,24 @@ class RegionCoordinator:
             if self._applied - self._last_snapshot < self._snapshot_every:
                 return
             idx = self._applied
-            state = {
-                "rid": self._rid.serialize_state(),
-                "scd": self._scd.serialize_state(),
-            }
+            rid_refs = self._rid.snapshot_refs()
+            scd_refs = self._scd.snapshot_refs()
+        # serialize OUTSIDE the lock: the refs are a consistent cut
+        # (records are immutable), so reads/writes never stall behind a
+        # 1M-intent JSON dump
+        state = {
+            "rid": type(self._rid).serialize_refs(rid_refs),
+            "scd": type(self._scd).serialize_refs(scd_refs),
+        }
+        state_json = json.dumps(state, separators=(",", ":"))
+        if len(state_json) > _SNAPSHOT_WARN_BYTES:
+            log.warning(
+                "region snapshot at %d is %.0f MB — approaching the "
+                "server's upload cap; compaction may stall if it grows",
+                idx, len(state_json) / 1e6,
+            )
         try:
-            if not self._client.put_snapshot(idx, state):
+            if not self._client.put_snapshot(idx, state_json=state_json):
                 log.warning(
                     "region snapshot at %d rejected; backing off one "
                     "interval", idx,
