@@ -9,7 +9,10 @@
 
 PY ?= python
 
-.PHONY: test e2e bench bench-all serve region-serve docker
+.PHONY: native test e2e bench bench-all serve region-serve docker
+
+native:
+	$(PY) -c "from dss_tpu import native; assert native.ensure_built(), 'g++ build failed'"
 
 test:
 	$(PY) -m pytest tests/ -q

@@ -1,0 +1,159 @@
+"""Native (C++) kernels for the host-side hot paths.
+
+JAX/XLA owns the device compute path; these cover the request-shaping
+work that runs per HTTP call on the host — currently the level-13
+covering (dss_tpu/geo/covering.py), whose numpy implementation costs
+~5 ms/request in small-op dispatch overhead.  The C++ kernel mirrors
+the numpy math operation-for-operation (IEEE double), so results are
+bit-identical; tests/test_native_covering.py pins that differentially.
+
+The shared library is built on demand with g++ (make native, or
+lazily at first import).  If the toolchain or build is unavailable the
+callers fall back to the numpy path — behavior never changes, only
+speed.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+import threading
+from typing import Optional
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "covering.cc")
+_SO = os.path.join(_DIR, "libdsscover.so")
+
+_load_lock = threading.Lock()   # guards _lib / _load_failed + dlopen
+_build_lock = threading.Lock()  # serializes g++ runs (never held with
+#                                 _load_lock, so available() can't
+#                                 block behind a compile)
+_lib = None
+_load_failed = False
+
+
+def _build() -> bool:
+    """Compile covering.cc -> libdsscover.so (atomic rename so racing
+    processes never load a half-written .so)."""
+    tmp = None
+    try:
+        fd, tmp = tempfile.mkstemp(suffix=".so", dir=_DIR)
+        os.close(fd)
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-o", tmp, _SRC],
+            check=True,
+            capture_output=True,
+            timeout=180,
+        )
+        os.replace(tmp, _SO)
+        return True
+    except Exception:
+        if tmp is not None:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+        return False
+
+
+def _so_fresh() -> bool:
+    return os.path.exists(_SO) and (
+        not os.path.exists(_SRC)
+        or os.path.getmtime(_SO) >= os.path.getmtime(_SRC)
+    )
+
+
+def _try_load() -> Optional[ctypes.CDLL]:
+    """dlopen the .so if fresh on disk.  Fast; never compiles."""
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    with _load_lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        if not _so_fresh():
+            return None
+        try:
+            lib = ctypes.CDLL(_SO)
+            lib.dss_loop_covering.restype = ctypes.c_int64
+            lib.dss_loop_covering.argtypes = [
+                ctypes.POINTER(ctypes.c_double),
+                ctypes.c_int32,
+                ctypes.c_int32,
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.c_int64,
+            ]
+            _lib = lib
+        except OSError:
+            _load_failed = True
+        return _lib
+
+
+def ensure_built() -> bool:
+    """Build (if needed) and load synchronously.  Call at startup or
+    from tests; the request path never compiles."""
+    global _load_failed
+    if _try_load() is not None:
+        return True
+    with _build_lock:
+        if _try_load() is not None:
+            return True
+        if not _so_fresh() and not _build():
+            with _load_lock:
+                _load_failed = True
+            return False
+    return _try_load() is not None
+
+
+def available() -> bool:
+    """True if the kernel is loaded (or the .so is fresh on disk and
+    loads instantly).  Never triggers a compile: a covering request
+    must not stall behind a multi-second g++ run — the background
+    build started at import flips this True when done."""
+    return _try_load() is not None
+
+
+# Kick the build off-thread at import: server processes get the kernel
+# a few seconds after boot without ever blocking a request on g++.
+if not _so_fresh():
+    threading.Thread(
+        target=ensure_built, name="dsscover-build", daemon=True
+    ).start()
+
+
+class CoveringTooLarge(Exception):
+    """Native covering exceeded the max cell count (AreaTooLarge)."""
+
+
+_OUT_CAP = 100_001
+
+
+def loop_covering(v_xyz: np.ndarray, area_ok: bool) -> Optional[np.ndarray]:
+    """Native single-face rect covering of the loop.
+
+    Returns the sorted uint64 cell array, None when the caller must
+    take the Python BFS fallback (multi-face / face-edge / oversized
+    rect / area gate failed / native unavailable), or raises
+    CoveringTooLarge.
+    """
+    lib = _try_load()
+    if lib is None:
+        return None
+    v = np.ascontiguousarray(v_xyz, dtype=np.float64)
+    out = np.empty(_OUT_CAP, dtype=np.uint64)
+    rc = lib.dss_loop_covering(
+        v.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        np.int32(len(v)),
+        np.int32(1 if area_ok else 0),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        np.int64(_OUT_CAP),
+    )
+    if rc == -2:
+        raise CoveringTooLarge("covering exceeds maximum cell count")
+    if rc < 0:
+        return None
+    return out[:rc].copy()
