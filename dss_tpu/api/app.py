@@ -115,7 +115,7 @@ def make_trace_middleware():
     @web.middleware
     async def trace_middleware(request, handler):
         rid = request.headers.get("X-Request-Id") or _uuid.uuid4().hex[:16]
-        request["dss_trace"] = {"request_id": rid, "stages": {}}
+        request["dss_trace"] = {"request_id": rid}
         try:
             resp = await handler(request)
         except web.HTTPException as e:
@@ -124,6 +124,13 @@ def make_trace_middleware():
             e.headers["X-Request-Id"] = rid
             raise
         resp.headers["X-Request-Id"] = rid
+        stages = request.get("dss_stages")
+        if stages:
+            # machine-readable per-stage breakdown for callers
+            # (benchmarks, USS operators correlating latency)
+            resp.headers["X-Dss-Stages"] = ";".join(
+                f"{k}={v}" for k, v in sorted(stages.items())
+            )
         return resp
 
     return trace_middleware
@@ -144,8 +151,11 @@ def make_timeout_middleware(timeout_s: float):
         if request.path in ("/healthy", "/debug/profile"):
             return await handler(request)
         try:
-            return await asyncio.wait_for(handler(request), timeout_s)
-        except asyncio.TimeoutError:
+            # asyncio.timeout cancels in-place (no extra task per
+            # request, unlike wait_for)
+            async with asyncio.timeout(timeout_s):
+                return await handler(request)
+        except TimeoutError:
             return _error_response(
                 errors.deadline_exceeded(
                     f"request exceeded the {timeout_s:g}s deadline"
@@ -160,18 +170,29 @@ async def _call(fn, *args, request=None):
     layer holds the store lock and may run multi-ms TPU kernels (first
     call: a multi-second jit compile); keeping it off the loop lets
     other requests (and /healthy) proceed — the goroutine-per-RPC
-    analog of grpc-go.  When `request` is given, the service duration
-    lands in its trace stages (--trace_requests)."""
+    analog of grpc-go.  When `request` is given, the per-stage sink is
+    installed on the worker thread so service code's covering/store/
+    serialize timings land in the request's stage breakdown."""
+    from dss_tpu.obs import stages as _stages
+
     loop = asyncio.get_running_loop()
+    sink = None if request is None else request.get("dss_stages")
     t0 = time.perf_counter()
+
+    def run():
+        if sink is not None:
+            _stages.set_sink(sink)
+        try:
+            return fn(*args)
+        finally:
+            if sink is not None:
+                _stages.set_sink(None)
+
     try:
-        return await loop.run_in_executor(
-            None, functools.partial(fn, *args)
-        )
+        return await loop.run_in_executor(None, run)
     finally:
-        tr = None if request is None else request.get("dss_trace")
-        if tr is not None:
-            tr["stages"]["service_ms"] = round(
+        if sink is not None:
+            sink["service_ms"] = round(
                 (time.perf_counter() - t0) * 1000, 3
             )
 
@@ -179,6 +200,122 @@ async def _call(fn, *args, request=None):
 async def _call_r(request, fn, *args):
     """Handler-side _call: threads the request through for tracing."""
     return await _call(fn, *args, request=request)
+
+
+# Routes a read-worker serves from its local WAL-tail replica; every
+# other route is proxied to the write leader.  Searches are the hot
+# path and inherently scan-like (bounded staleness = the follower poll
+# interval, same contract as a region-mode non-writing instance);
+# point reads and all mutations go to the leader for freshness.
+WORKER_LOCAL_ROUTES = {
+    ("GET", "/healthy"),
+    ("GET", "/metrics"),
+    ("GET", "/aux/v1/validate_oauth"),
+    ("GET", "/v1/dss/identification_service_areas"),
+    ("GET", "/v1/dss/subscriptions"),
+    ("POST", "/dss/v1/operation_references/query"),
+    ("POST", "/dss/v1/subscriptions/query"),
+    ("POST", "/dss/v1/constraint_references/query"),
+}
+
+_PROXY_SKIP_HEADERS = {
+    "host", "content-length", "transfer-encoding", "connection",
+}
+
+
+def make_worker_proxy_middleware(leader_url: str, follower=None):
+    """Read-worker request routing: local replica for searches, proxy
+    to the leader for everything else.  After a successful proxied
+    mutation the worker waits (bounded) for its replica to reach the
+    leader's WAL seq — read-your-writes for clients that keep their
+    connection (and thus this worker) across a write->search flow."""
+    import aiohttp as _aiohttp
+
+    session: dict = {}
+
+    async def _get_session():
+        if "s" not in session:
+            session["s"] = _aiohttp.ClientSession(
+                timeout=_aiohttp.ClientTimeout(total=60)
+            )
+        return session["s"]
+
+    @web.middleware
+    async def worker_proxy(request, handler):
+        resource = (
+            request.match_info.route.resource
+            if request.match_info is not None
+            else None
+        )
+        canonical = resource.canonical if resource is not None else None
+        if (request.method, canonical) in WORKER_LOCAL_ROUTES:
+            return await handler(request)
+        sess = await _get_session()
+        body = await request.read()
+        headers = {
+            k: v
+            for k, v in request.headers.items()
+            if k.lower() not in _PROXY_SKIP_HEADERS
+        }
+        try:
+            async with sess.request(
+                request.method,
+                leader_url + request.path_qs,
+                data=body,
+                headers=headers,
+            ) as upstream:
+                payload = await upstream.read()
+                seq = upstream.headers.get("X-Dss-Wal-Seq")
+        except (_aiohttp.ClientError, asyncio.TimeoutError) as e:
+            return _error_response(
+                errors.unavailable(f"write leader unreachable: {e}")
+            )
+        if (
+            follower is not None
+            and seq
+            and request.method in ("PUT", "DELETE", "POST")
+            and upstream.status < 400
+        ):
+            loop = asyncio.get_running_loop()
+            await loop.run_in_executor(
+                None, functools.partial(follower.wait_for, int(seq), 1.0)
+            )
+        return web.Response(
+            body=payload,
+            status=upstream.status,
+            content_type=upstream.content_type,
+        )
+
+    async def close_session(app):
+        if "s" in session:
+            await session["s"].close()
+
+    worker_proxy.on_cleanup = close_session
+    return worker_proxy
+
+
+def make_wal_seq_middleware(wal_seq_fn):
+    """Leader-side: stamp the current WAL seq on successful mutation
+    responses so read workers can wait for their replica to catch up
+    (read-your-writes across the proxy)."""
+
+    @web.middleware
+    async def wal_seq(request, handler):
+        resp = await handler(request)
+        if request.method in ("PUT", "DELETE", "POST") and resp.status < 400:
+            resp.headers["X-Dss-Wal-Seq"] = str(wal_seq_fn())
+        return resp
+
+    return wal_seq
+
+
+def _native_ready() -> bool:
+    try:
+        from dss_tpu import native
+
+        return native.available()
+    except Exception:  # pragma: no cover
+        return False
 
 
 async def _params(request) -> dict:
@@ -207,6 +344,9 @@ def build_app(
     replica=None,  # ShardedOpReplica: multi-chip read-replica surface
     trace_requests: bool = False,
     profile_dir: str = "",
+    worker_proxy=None,  # read-worker mode: proxy middleware to leader
+    wal_seq_fn=None,  # leader mode: stamp WAL seq on mutations
+    inline_reads: bool = False,  # run read handlers on the event loop
 ) -> web.Application:
     from dss_tpu.obs.logging import make_access_log_middleware
 
@@ -218,7 +358,40 @@ def build_app(
     if default_timeout_s and default_timeout_s > 0:
         middlewares.append(make_timeout_middleware(default_timeout_s))
     middlewares.append(error_middleware)
+    if wal_seq_fn is not None:
+        middlewares.append(make_wal_seq_middleware(wal_seq_fn))
+    if worker_proxy is not None:
+        # innermost: local-read routes fall through to handlers, the
+        # rest forward to the leader (already wrapped by log/deadline)
+        middlewares.append(worker_proxy)
     app = web.Application(middlewares=middlewares)
+    if worker_proxy is not None and hasattr(worker_proxy, "on_cleanup"):
+        app.on_cleanup.append(worker_proxy.on_cleanup)
+
+    async def _call_read(request, fn, *args):
+        """Service call for READ handlers.  With inline_reads (single-
+        core hosts), runs directly on the event loop: reads are
+        lock-free against the immutable store state and take ~0.3 ms,
+        so on one core the two executor handoffs are pure overhead.
+        Multi-core deployments keep the executor (loop stays free)."""
+        if not inline_reads or not _native_ready():
+            # without the native covering kernel a search can fall back
+            # to a multi-ms numpy BFS — keep that off the event loop
+            return await _call(fn, *args, request=request)
+        from dss_tpu.obs import stages as _stages
+
+        sink = request.get("dss_stages")
+        t0 = time.perf_counter()
+        if sink is not None:
+            _stages.set_sink(sink)
+        try:
+            return fn(*args)
+        finally:
+            if sink is not None:
+                _stages.set_sink(None)
+                sink["service_ms"] = round(
+                    (time.perf_counter() - t0) * 1000, 3
+                )
 
     def auth(request, operation: str) -> str:
         """-> owner.  No authorizer configured (unit harness) -> anon."""
@@ -230,9 +403,9 @@ def build_app(
                 request.headers.get("Authorization"), operation
             )
         finally:
-            tr = request.get("dss_trace")
-            if tr is not None:
-                tr["stages"]["auth_ms"] = round(
+            sink = request.get("dss_stages")
+            if sink is not None:
+                sink["auth_ms"] = round(
                     (time.perf_counter() - t0) * 1000, 3
                 )
         request["dss_owner"] = owner
@@ -421,12 +594,12 @@ def build_app(
 
         async def isa_get(request):
             auth(request, _RID + "GetIdentificationServiceArea")
-            return web.json_response(await _call_r(request, rid.get_isa, request.match_info["id"]))
+            return web.json_response(await _call_read(request, rid.get_isa, request.match_info["id"]))
 
         async def isa_search(request):
             auth(request, _RID + "SearchIdentificationServiceAreas")
             return web.json_response(
-                await _call_r(request, rid.search_isas, 
+                await _call_read(request, rid.search_isas, 
                     request.query.get("area", ""),
                     request.query.get("earliest_time"),
                     request.query.get("latest_time"),
@@ -465,13 +638,13 @@ def build_app(
         async def sub_get(request):
             auth(request, _RID + "GetSubscription")
             return web.json_response(
-                await _call_r(request, rid.get_subscription, request.match_info["id"])
+                await _call_read(request, rid.get_subscription, request.match_info["id"])
             )
 
         async def sub_search(request):
             owner = auth(request, _RID + "SearchSubscriptions")
             return web.json_response(
-                await _call_r(request, rid.search_subscriptions, request.query.get("area", ""), owner)
+                await _call_read(request, rid.search_subscriptions, request.query.get("area", ""), owner)
             )
 
         base = "/v1/dss/identification_service_areas"
@@ -505,7 +678,7 @@ def build_app(
         async def op_get(request):
             owner = auth(request, _SCD + "GetOperationReference")
             return web.json_response(
-                await _call_r(request, scd.get_operation, request.match_info["entityuuid"], owner)
+                await _call_read(request, scd.get_operation, request.match_info["entityuuid"], owner)
             )
 
         async def op_delete(request):
@@ -517,7 +690,7 @@ def build_app(
         async def op_query(request):
             owner = auth(request, _SCD + "SearchOperationReferences")
             return web.json_response(
-                await _call_r(request, scd.search_operations, await _params(request), owner)
+                await _call_read(request, scd.search_operations, await _params(request), owner)
             )
 
         async def scd_sub_put(request):
@@ -549,7 +722,7 @@ def build_app(
         async def scd_sub_query(request):
             owner = auth(request, _SCD + "QuerySubscriptions")
             return web.json_response(
-                await _call_r(request, scd.query_subscriptions, await _params(request), owner)
+                await _call_read(request, scd.query_subscriptions, await _params(request), owner)
             )
 
         async def constraint_put(request):
@@ -575,7 +748,7 @@ def build_app(
         async def constraint_query(request):
             auth(request, _SCD + "QueryConstraintReferences")
             return web.json_response(
-                await _call_r(request, scd.query_constraints, await _params(request))
+                await _call_read(request, scd.query_constraints, await _params(request))
             )
 
         async def dss_report(request):

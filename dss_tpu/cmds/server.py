@@ -145,6 +145,42 @@ def make_parser() -> argparse.ArgumentParser:
         help="skip the background fused-kernel compile at startup",
     )
     p.add_argument(
+        "--workers",
+        type=int,
+        default=0,
+        help="spawn N read-worker processes sharing the listen port "
+        "via SO_REUSEPORT: the leader owns the TPU + all mutations "
+        "(journaled to the WAL), workers serve searches from a "
+        "WAL-tail replica and proxy everything else to the leader "
+        "(the goroutine-per-RPC scale-out analog, grpc-backend "
+        "main.go:201-214).  0 = single process.  Standalone mode only.",
+    )
+    p.add_argument(
+        "--worker_reader",
+        action="store_true",
+        help=argparse.SUPPRESS,  # internal: this process is a read worker
+    )
+    p.add_argument(
+        "--leader_url",
+        default="",
+        help=argparse.SUPPRESS,  # internal: leader base URL for proxying
+    )
+    p.add_argument(
+        "--follower_poll_interval",
+        type=float,
+        default=0.02,
+        help="read-worker WAL tail interval in seconds (staleness bound)",
+    )
+    p.add_argument(
+        "--inline_reads",
+        default="auto",
+        choices=["auto", "on", "off"],
+        help="run read handlers directly on the event loop instead of "
+        "the thread-pool executor.  'auto' enables it on single-core "
+        "hosts, where the two executor handoffs are pure overhead "
+        "(reads are lock-free and sub-millisecond)",
+    )
+    p.add_argument(
         "--default_timeout",
         type=float,
         default=10.0,
@@ -163,9 +199,110 @@ def make_parser() -> argparse.ArgumentParser:
     return p
 
 
+def build_worker(args) -> web.Application:
+    """A read worker: local WAL-tail replica serves searches; every
+    other route proxies to the leader.  Runs on the CPU backend — the
+    leader owns the (single-client) TPU; worker store queries take the
+    host path, which is exact and fast at serving batch sizes."""
+    from dss_tpu.api.app import make_worker_proxy_middleware
+    from dss_tpu.dar.follower import WalFollower
+    from dss_tpu.obs.logging import configure_logging, get_logger
+    from dss_tpu.obs.metrics import MetricsRegistry
+
+    configure_logging()
+    log = get_logger("dss.worker")
+    if not args.wal_path or not args.leader_url:
+        raise SystemExit("--worker_reader needs --wal_path and --leader_url")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    clock = Clock()
+    store = DSSStore(storage=args.storage, clock=clock)
+    follower = WalFollower(
+        store, args.wal_path, interval_s=args.follower_poll_interval
+    )
+    follower.start()
+    log.info(
+        "read worker up: replica from %s every %.0f ms, leader %s",
+        args.wal_path, args.follower_poll_interval * 1000, args.leader_url,
+    )
+    rid = RIDService(store.rid, clock)
+    scd = SCDService(store.scd, clock) if args.enable_scd else None
+    authorizer = _make_authorizer(args)
+    metrics = MetricsRegistry()
+    from dss_tpu.build_info import build_info
+
+    metrics.set_info("dss_build_info", build_info())
+
+    def stats_fn():
+        out = store.stats()
+        out.update(follower.stats())
+        return out
+
+    return build_app(
+        rid,
+        scd,
+        authorizer,
+        enable_scd=args.enable_scd,
+        metrics=metrics,
+        dump_requests=args.dump_requests,
+        stats_fn=stats_fn,
+        default_timeout_s=args.default_timeout,
+        trace_requests=args.trace_requests,
+        inline_reads=_inline_reads(args),
+        worker_proxy=make_worker_proxy_middleware(
+            args.leader_url, follower=follower
+        ),
+    )
+
+
+def _inline_reads(args) -> bool:
+    if args.inline_reads == "on":
+        return True
+    if args.inline_reads == "off":
+        return False
+    return (os.cpu_count() or 2) == 1
+
+
+def _make_authorizer(args):
+    if args.insecure_no_auth:
+        return None
+    if args.public_key_files:
+        resolver = StaticKeyResolver.from_files(
+            [f for f in args.public_key_files.split(",") if f]
+        )
+    elif args.jwks_endpoint:
+        resolver = JWKSResolver(
+            args.jwks_endpoint,
+            [k for k in args.jwks_key_ids.split(",") if k] or None,
+        )
+    else:
+        raise SystemExit(
+            "one of --public_key_files / --jwks_endpoint is required "
+            "(or --insecure_no_auth)"
+        )
+    audiences = [a for a in args.accepted_jwt_audiences.split(",") if a]
+    if not audiences:
+        raise SystemExit(
+            "--accepted_jwt_audiences is required when auth is enabled "
+            "(every token would be rejected otherwise)"
+        )
+    scopes = dict(RID_SCOPES)
+    scopes.update(SCD_SCOPES)
+    return Authorizer(
+        resolver,
+        audiences=audiences,
+        scopes_table=scopes,
+        refresh_interval_s=args.key_refresh_timer or None,
+    )
+
+
 def build(args) -> web.Application:
     from dss_tpu.obs.logging import configure_logging, get_logger
     from dss_tpu.obs.metrics import MetricsRegistry
+
+    if args.worker_reader:
+        return build_worker(args)
 
     configure_logging()
     log = get_logger("dss.server")
@@ -230,36 +367,7 @@ def build(args) -> web.Application:
 
         threading.Thread(target=_warm, name="fastpath-warmup", daemon=True).start()
 
-    authorizer = None
-    if not args.insecure_no_auth:
-        if args.public_key_files:
-            resolver = StaticKeyResolver.from_files(
-                [f for f in args.public_key_files.split(",") if f]
-            )
-        elif args.jwks_endpoint:
-            resolver = JWKSResolver(
-                args.jwks_endpoint,
-                [k for k in args.jwks_key_ids.split(",") if k] or None,
-            )
-        else:
-            raise SystemExit(
-                "one of --public_key_files / --jwks_endpoint is required "
-                "(or --insecure_no_auth)"
-            )
-        audiences = [a for a in args.accepted_jwt_audiences.split(",") if a]
-        if not audiences:
-            raise SystemExit(
-                "--accepted_jwt_audiences is required when auth is enabled "
-                "(every token would be rejected otherwise)"
-            )
-        scopes = dict(RID_SCOPES)
-        scopes.update(SCD_SCOPES)
-        authorizer = Authorizer(
-            resolver,
-            audiences=audiences,
-            scopes_table=scopes,
-            refresh_interval_s=args.key_refresh_timer or None,
-        )
+    authorizer = _make_authorizer(args)
 
     metrics = MetricsRegistry()
     metrics.set_info("dss_build_info", build_info())
@@ -330,11 +438,138 @@ def build(args) -> web.Application:
         replica=replica,
         trace_requests=args.trace_requests,
         profile_dir=args.profile_dir,
+        inline_reads=_inline_reads(args),
+        # workers wait on this seq for read-your-writes after a
+        # proxied mutation
+        wal_seq_fn=(lambda: store.wal.seq) if args.workers > 0 else None,
     )
 
 
+def _public_socket(addr: str, reuse_port: bool):
+    import socket
+
+    host, _, port = addr.rpartition(":")
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    if reuse_port:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+    s.bind((host or "0.0.0.0", int(port)))
+    s.listen(1024)
+    return s
+
+
+def _watch_parent():
+    """Read workers exit when the leader dies (no orphaned listeners
+    competing on the port)."""
+    import threading
+    import time as _time
+
+    parent = os.getppid()
+
+    def loop():
+        while True:
+            if os.getppid() != parent:
+                os._exit(0)
+            _time.sleep(1.0)
+
+    threading.Thread(target=loop, name="parent-watch", daemon=True).start()
+
+
+def _forward_args(args, leader_url: str):
+    """argv for a read-worker child."""
+    out = [
+        "--worker_reader",
+        "--leader_url", leader_url,
+        "--addr", args.addr,
+        "--storage", args.storage,
+        "--wal_path", args.wal_path,
+        "--default_timeout", str(args.default_timeout),
+        "--shutdown_grace", str(args.shutdown_grace),
+        "--follower_poll_interval", str(args.follower_poll_interval),
+        "--inline_reads", args.inline_reads,
+    ]
+    if args.enable_scd:
+        out.append("--enable_scd")
+    if args.insecure_no_auth:
+        out.append("--insecure_no_auth")
+    if args.public_key_files:
+        out += ["--public_key_files", args.public_key_files]
+    if args.jwks_endpoint:
+        out += ["--jwks_endpoint", args.jwks_endpoint]
+    if args.jwks_key_ids:
+        out += ["--jwks_key_ids", args.jwks_key_ids]
+    if args.key_refresh_timer:
+        out += ["--key_refresh_timer", str(args.key_refresh_timer)]
+    if args.accepted_jwt_audiences:
+        out += ["--accepted_jwt_audiences", args.accepted_jwt_audiences]
+    if args.dump_requests:
+        out.append("--dump_requests")
+    if args.trace_requests:
+        out.append("--trace_requests")
+    return out
+
+
 def main():
+    import atexit
+    import socket
+    import subprocess
+    import sys
+    import tempfile
+
     args = make_parser().parse_args()
+
+    if args.worker_reader:
+        _watch_parent()
+        app = build(args)
+        sock = _public_socket(args.addr, reuse_port=True)
+        web.run_app(
+            app, sock=sock, shutdown_timeout=args.shutdown_grace
+        )
+        return
+
+    if args.workers > 0:
+        if args.region_url:
+            raise SystemExit(
+                "--workers is standalone-only (region instances already "
+                "scale horizontally; run more instances instead)"
+            )
+        if not args.wal_path:
+            args.wal_path = os.path.join(
+                tempfile.mkdtemp(prefix="dss-wal-"), "wal.jsonl"
+            )
+        app = build(args)
+        # leader listens on the shared public port AND a loopback port
+        # the workers proxy writes to
+        pub = _public_socket(args.addr, reuse_port=True)
+        internal = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        internal.bind(("127.0.0.1", 0))
+        internal.listen(1024)
+        leader_url = f"http://127.0.0.1:{internal.getsockname()[1]}"
+        children = []
+        child_argv = [
+            sys.executable, "-m", "dss_tpu.cmds.server",
+        ] + _forward_args(args, leader_url)
+        for _ in range(args.workers):
+            children.append(subprocess.Popen(child_argv))
+
+        def reap():
+            for c in children:
+                if c.poll() is None:
+                    c.terminate()
+            for c in children:
+                try:
+                    c.wait(timeout=args.shutdown_grace + 5)
+                except subprocess.TimeoutExpired:
+                    c.kill()
+
+        atexit.register(reap)
+        web.run_app(
+            app,
+            sock=[pub, internal],
+            shutdown_timeout=args.shutdown_grace,
+        )
+        return
+
     app = build(args)
     host, _, port = args.addr.rpartition(":")
     # run_app installs SIGINT/SIGTERM handlers: the listener stops

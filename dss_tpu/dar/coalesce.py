@@ -53,6 +53,7 @@ class QueryCoalescer:
         self._cond = threading.Condition()
         self._queue: List[_Item] = []
         self._closed = False
+        self._busy = False  # a batch is executing on the worker
         self._thread: Optional[threading.Thread] = None
 
     def _ensure_thread(self):
@@ -78,13 +79,33 @@ class QueryCoalescer:
         if len(keys) == 0:
             return []
         item = _Item(keys, alt_lo, alt_hi, t_start, t_end, now, owner_id)
+        inline = False
         with self._cond:
             if self._closed:
                 raise RuntimeError("coalescer is closed")
-            self._queue.append(item)
-            self._ensure_thread()
-            self._cond.notify()
-        item.event.wait()
+            if not self._busy and not self._queue:
+                # lone caller: run inline as a batch of 1 — skips two
+                # thread handoffs (~0.15 ms on a loaded host).  Reads
+                # are lock-free (immutable state grab), so executing on
+                # the caller's thread is safe; `_busy` makes arrivals
+                # during execution queue up and batch as before.
+                self._busy = True
+                inline = True
+            else:
+                self._queue.append(item)
+                self._ensure_thread()
+                self._cond.notify()
+        if inline:
+            try:
+                self._execute([item])
+            finally:
+                with self._cond:
+                    self._busy = False
+                    if self._queue and not self._closed:
+                        self._ensure_thread()
+                        self._cond.notify()
+        else:
+            item.event.wait()
         if item.error is not None:
             raise item.error
         return item.result
@@ -105,13 +126,20 @@ class QueryCoalescer:
     def _run(self):
         while True:
             with self._cond:
-                while not self._queue and not self._closed:
+                # also wait while an inline batch is executing: its
+                # arrivals should form ONE next batch, not race it
+                while (not self._queue or self._busy) and not self._closed:
                     self._cond.wait()
                 if self._closed and not self._queue:
                     return
                 batch = self._queue[:_MAX_BATCH]
                 del self._queue[:_MAX_BATCH]
-            self._execute(batch)
+                self._busy = True
+            try:
+                self._execute(batch)
+            finally:
+                with self._cond:
+                    self._busy = False
 
     def _execute(self, batch: List[_Item]):
         try:

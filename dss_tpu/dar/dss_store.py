@@ -927,15 +927,20 @@ class DSSStore:
         else:
             self.wal.append(rec)
 
+    def apply_log_record(self, rec: dict) -> None:
+        """Apply one WAL/region-log record to the right sub-store
+        (caller holds the lock and has set _replaying)."""
+        t = rec.get("t", "")
+        if t.startswith("isa") or t.startswith("rid"):
+            self.rid.apply_wal(rec)
+        else:
+            self.scd.apply_wal(rec)
+
     def _replay(self):
         self._replaying = True
         try:
             for rec in self.wal.replay():
-                t = rec.get("t", "")
-                if t.startswith("isa") or t.startswith("rid"):
-                    self.rid.apply_wal(rec)
-                else:
-                    self.scd.apply_wal(rec)
+                self.apply_log_record(rec)
         finally:
             self._replaying = False
 

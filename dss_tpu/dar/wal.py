@@ -32,6 +32,11 @@ class WriteAheadLog:
                     self._seq = max(self._seq, rec.get("seq", 0))
             self._fh = open(path, "a", encoding="utf-8")
 
+    @property
+    def seq(self) -> int:
+        """Last assigned sequence number (leader-side freshness stamp)."""
+        return self._seq
+
     def append(self, record: dict) -> int:
         with self._lock:
             self._seq += 1

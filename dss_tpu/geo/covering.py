@@ -596,6 +596,25 @@ def covering_from_loop_points(points_xyz) -> np.ndarray:
     """Covering of the loop through the given points, with the reference's
     winding-retry / area-limit / polyline-fallback semantics
     (pkg/geo/s2.go:97-122)."""
+    # native fast path: winding retry + area gate + rect covering in
+    # ONE call (same op order as the code below; differentially pinned
+    # by tests/test_native_covering.py).  None -> run the full Python
+    # path (multi-face, face-edge margin, oversized rect, no lib).
+    if _native is not None and _native.available():
+        arr = np.ascontiguousarray(points_xyz, dtype=np.float64)
+        try:
+            cells = _native.points_covering(arr, MAX_AREA_KM2)
+            if cells is not None:
+                return cells
+        except _native.AreaTooLarge as e:
+            raise AreaTooLargeError(
+                f"area is too large ({e.area:f}km² > {MAX_AREA_KM2:f}km²)"
+            )
+        except _native.Degenerate:
+            return covering_polyline(arr)
+        except _native.CoveringTooLarge:
+            raise AreaTooLargeError("covering exceeds maximum cell count")
+
     pts = list(np.asarray(points_xyz, dtype=np.float64))
     loop = Loop(np.asarray(pts))
     area = loop_area_km2(loop)
@@ -698,10 +717,10 @@ def _area_to_cell_ids_impl(area: str) -> np.ndarray:
             coords.append(float(raw.strip()))
         except ValueError:
             raise BadAreaError("coordinates did not create a well formed area")
-    pts = [
-        latlng_to_xyz(coords[k], coords[k + 1]) for k in range(0, len(coords), 2)
-    ]
-    return covering_from_loop_points(np.asarray(pts))
+    # one vectorized conversion (scalar latlng_to_xyz per vertex costs
+    # ~25 us each in numpy dispatch — this path is per-request hot)
+    pts = latlng_to_xyz(coords[0::2], coords[1::2])
+    return covering_from_loop_points(pts)
 
 
 def validate_cell(cell_id) -> None:

@@ -87,6 +87,15 @@ def _try_load() -> Optional[ctypes.CDLL]:
                 ctypes.POINTER(ctypes.c_uint64),
                 ctypes.c_int64,
             ]
+            lib.dss_points_covering.restype = ctypes.c_int64
+            lib.dss_points_covering.argtypes = [
+                ctypes.POINTER(ctypes.c_double),
+                ctypes.c_int32,
+                ctypes.c_double,
+                ctypes.POINTER(ctypes.c_double),
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.c_int64,
+            ]
             _lib = lib
         except OSError:
             _load_failed = True
@@ -103,8 +112,10 @@ def ensure_built() -> bool:
         if _try_load() is not None:
             return True
         if not _so_fresh() and not _build():
-            with _load_lock:
-                _load_failed = True
+            # build failure does NOT latch: a later `make native` (or a
+            # sibling process's build) producing a fresh .so is picked
+            # up by the next _try_load stat.  Only dlopen of a fresh
+            # .so latches _load_failed.
             return False
     return _try_load() is not None
 
@@ -130,6 +141,16 @@ class CoveringTooLarge(Exception):
 
 
 _OUT_CAP = 100_001
+_tls = threading.local()
+
+
+def _out_buf() -> np.ndarray:
+    """Reusable per-thread output buffer: allocating 800 KB per call
+    costs more than the kernel itself."""
+    buf = getattr(_tls, "buf", None)
+    if buf is None:
+        buf = _tls.buf = np.empty(_OUT_CAP, dtype=np.uint64)
+    return buf
 
 
 def loop_covering(v_xyz: np.ndarray, area_ok: bool) -> Optional[np.ndarray]:
@@ -144,7 +165,7 @@ def loop_covering(v_xyz: np.ndarray, area_ok: bool) -> Optional[np.ndarray]:
     if lib is None:
         return None
     v = np.ascontiguousarray(v_xyz, dtype=np.float64)
-    out = np.empty(_OUT_CAP, dtype=np.uint64)
+    out = _out_buf()
     rc = lib.dss_loop_covering(
         v.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
         np.int32(len(v)),
@@ -153,6 +174,52 @@ def loop_covering(v_xyz: np.ndarray, area_ok: bool) -> Optional[np.ndarray]:
         np.int64(_OUT_CAP),
     )
     if rc == -2:
+        raise CoveringTooLarge("covering exceeds maximum cell count")
+    if rc < 0:
+        return None
+    return out[:rc].copy()
+
+
+class AreaTooLarge(Exception):
+    """Loop exceeds the area gate even after the winding retry; .area
+    carries the computed km² for the error message."""
+
+    def __init__(self, area: float):
+        super().__init__(f"area is too large ({area:f}km²)")
+        self.area = area
+
+
+class Degenerate(Exception):
+    """Zero/negative area: the caller takes the polyline path."""
+
+
+def points_covering(v_xyz: np.ndarray, max_area_km2: float):
+    """covering_from_loop_points fast path: winding retry + area gate +
+    rect covering in one native call.  The area gate threshold comes
+    from the caller (covering.MAX_AREA_KM2 — single source of truth).
+    Returns the sorted uint64 cells, or None when the caller must run
+    the full Python path; raises AreaTooLarge / Degenerate /
+    CoveringTooLarge per the gate results.
+    """
+    lib = _try_load()
+    if lib is None:
+        return None
+    v = np.ascontiguousarray(v_xyz, dtype=np.float64)
+    out = _out_buf()
+    area = ctypes.c_double(0.0)
+    rc = lib.dss_points_covering(
+        v.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        np.int32(len(v)),
+        ctypes.c_double(max_area_km2),
+        ctypes.byref(area),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        np.int64(_OUT_CAP),
+    )
+    if rc == -1:
+        raise Degenerate()
+    if rc == -2:
+        if area.value > max_area_km2:
+            raise AreaTooLarge(area.value)
         raise CoveringTooLarge("covering exceeds maximum cell count")
     if rc < 0:
         return None

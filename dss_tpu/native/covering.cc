@@ -441,4 +441,64 @@ int64_t dss_loop_covering(const double* v_xyz, int32_t n, int32_t area_ok,
   return count;
 }
 
+// Full covering_from_loop_points fast path (covering.py:596-613):
+// signed-area + winding-retry + area-gate + rect covering in one call.
+// Vertices arrive as unit xyz (Python computes latlng->xyz so numpy's
+// SIMD trig stays the parity reference for vertex positions).
+//
+//   area_out: loop_area_km2 of the (possibly reversed) loop — the
+//             reference's quirk formula (area_sr * 510072000) / 4 * pi
+// Returns: >= 0 cell count; -1 degenerate (area <= 0: caller takes the
+// polyline path); -2 AreaTooLarge (either the area gate after the
+// winding retry, or the covering cell cap); -3 caller must run the
+// full Python path (multi-face / face-edge / oversized rect / buffer).
+int64_t dss_points_covering(const double* v_xyz_in, int32_t n,
+                            double max_area_km2, double* area_out,
+                            uint64_t* out, int64_t out_cap) {
+  if (n < 1) return -3;
+  // signed spherical area via the vertex-0 triangle fan
+  // (covering.py Loop.signed_area:219-230; same op order)
+  std::vector<double> v(v_xyz_in, v_xyz_in + 3 * n);
+  auto signed_area = [&](const double* vv) {
+    if (n < 3) return 0.0;
+    double total = 0.0;
+    const double* v0 = vv;
+    for (int k = 1; k < n - 1; ++k) {
+      const double* b = vv + 3 * k;
+      const double* c = vv + 3 * (k + 1);
+      double x[3];
+      cross3(v0, b, x);
+      const double triple = dot3(x, c);
+      const double denom =
+          1.0 + dot3(v0, b) + dot3(b, c) + dot3(c, v0);
+      total += 2.0 * std::atan2(triple, denom);
+    }
+    return total;
+  };
+  constexpr double EARTH_AREA_KM2 = 510072000.0;
+  const double MAX_AREA_KM2 = max_area_km2;  // single source: covering.py
+  const double PI = 3.14159265358979323846;
+  auto area_km2 = [&](const double* vv) {
+    double s = signed_area(vv);
+    const double interior = s >= 0 ? s : 4.0 * PI + s;
+    return (interior * EARTH_AREA_KM2) / 4.0 * PI;
+  };
+  double a = area_km2(v.data());
+  if (a > MAX_AREA_KM2) {
+    // winding retry: reverse vertex order (covering.py:602-605)
+    std::vector<double> rev(3 * n);
+    for (int k = 0; k < n; ++k) {
+      rev[3 * k] = v[3 * (n - 1 - k)];
+      rev[3 * k + 1] = v[3 * (n - 1 - k) + 1];
+      rev[3 * k + 2] = v[3 * (n - 1 - k) + 2];
+    }
+    v.swap(rev);
+    a = area_km2(v.data());
+  }
+  *area_out = a;
+  if (a > MAX_AREA_KM2) return -2;
+  if (a <= 0) return -1;  // degenerate: polyline fallback
+  return dss_loop_covering(v.data(), n, 1, out, out_cap);
+}
+
 }  // extern "C"

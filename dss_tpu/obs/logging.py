@@ -86,6 +86,10 @@ def make_access_log_middleware(metrics=None, dump_requests: bool = False):
     @web.middleware
     async def access_log(request, handler):
         start = time.perf_counter()
+        # per-stage timing sink: service code (via obs.stages) and the
+        # auth/service call wrappers add parse/auth/covering/store/
+        # serialize millisecond entries here
+        request["dss_stages"] = {}
         body = None
         if dump_requests and request.can_read_body:
             # bound the dump buffer: skip bodies over 64 KB (or with no
@@ -115,23 +119,25 @@ def make_access_log_middleware(metrics=None, dump_requests: bool = False):
             raise
         finally:
             dur = time.perf_counter() - start
-            fields = {
-                "method": request.method,
-                "path": request.path,
-                "status": status,
-                "duration_ms": round(dur * 1000, 3),
-                "remote": request.remote,
-            }
-            owner = request.get("dss_owner")
-            if owner:
-                fields["owner"] = owner
-            tr = request.get("dss_trace")
-            if tr is not None:
-                fields["request_id"] = tr["request_id"]
-                fields.update(tr["stages"])
-            if body is not None:
-                fields["request_body"] = body[:4096]
-            log_fields(logger, logging.INFO, "request", **fields)
+            stages = request.get("dss_stages") or {}
+            if logger.isEnabledFor(logging.INFO):
+                fields = {
+                    "method": request.method,
+                    "path": request.path,
+                    "status": status,
+                    "duration_ms": round(dur * 1000, 3),
+                    "remote": request.remote,
+                }
+                owner = request.get("dss_owner")
+                if owner:
+                    fields["owner"] = owner
+                fields.update(stages)
+                tr = request.get("dss_trace")
+                if tr is not None:
+                    fields["request_id"] = tr["request_id"]
+                if body is not None:
+                    fields["request_body"] = body[:4096]
+                log_fields(logger, logging.INFO, "request", **fields)
             if metrics is not None:
                 # label with the matched route's canonical pattern
                 # (/v1/.../{id}) so untrusted path segments can never
@@ -148,5 +154,7 @@ def make_access_log_middleware(metrics=None, dump_requests: bool = False):
                     else "(unmatched)"
                 )
                 metrics.observe_request(request.method, route, status, dur)
+                for st, ms in stages.items():
+                    metrics.observe_stage(route, st, ms / 1000.0)
 
     return access_log

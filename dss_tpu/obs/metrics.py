@@ -62,6 +62,8 @@ class MetricsRegistry:
         self._hist_cnt: Dict[Tuple[str, str], int] = {}
         self._gauges: Dict[str, float] = {}
         self._infos: Dict[str, Dict[str, str]] = {}
+        self._stage_sum: Dict[Tuple[str, str], float] = {}
+        self._stage_cnt: Dict[Tuple[str, str], int] = {}
 
     def observe_request(
         self, method: str, path: str, status: int, duration_s: float
@@ -80,6 +82,14 @@ class MetricsRegistry:
                     self._hist[hk][i] += 1
             self._hist_sum[hk] += duration_s
             self._hist_cnt[hk] += 1
+
+    def observe_stage(self, route: str, stage: str, duration_s: float) -> None:
+        """Per-stage serving-time accounting (parse/auth/covering/
+        store/serialize) so the p50 breakdown is measured, not guessed."""
+        with self._lock:
+            k = (route_template(route), stage)
+            self._stage_sum[k] = self._stage_sum.get(k, 0.0) + duration_s
+            self._stage_cnt[k] = self._stage_cnt.get(k, 0) + 1
 
     def set_gauge(self, name: str, value: float) -> None:
         with self._lock:
@@ -138,6 +148,21 @@ class MetricsRegistry:
                     f"dss_request_duration_seconds_count{{{lab}}} "
                     f"{self._hist_cnt[hk]}"
                 )
+            if self._stage_cnt:
+                lines.append("# TYPE dss_request_stage_seconds summary")
+                for k in sorted(self._stage_cnt):
+                    r, st = k
+                    lab = (
+                        f'route="{_esc_label(r)}",stage="{_esc_label(st)}"'
+                    )
+                    lines.append(
+                        f"dss_request_stage_seconds_sum{{{lab}}} "
+                        f"{self._stage_sum[k]:.6f}"
+                    )
+                    lines.append(
+                        f"dss_request_stage_seconds_count{{{lab}}} "
+                        f"{self._stage_cnt[k]}"
+                    )
             for name, v in sorted(self._gauges.items()):
                 lines.append(f"# TYPE {name} gauge")
                 lines.append(f"{name} {v}")

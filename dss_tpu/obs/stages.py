@@ -1,0 +1,45 @@
+"""Thread-local per-stage timing sink for request handling.
+
+The serving stack (api/app.py `_call`) installs a per-request dict as
+this thread's sink before invoking the synchronous service layer;
+service code brackets its phases with `stage("covering_ms")` etc.  The
+access-log middleware then emits the collected stages to the trace log,
+the X-Dss-Stages response header (when tracing), and aggregate
+counters in /metrics — so "where does the p50 go" is measured per
+stage instead of guessed (the per-RPC latency breakdown the reference
+gets from its SQL tracing).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+
+_tls = threading.local()
+
+
+def set_sink(sink) -> None:
+    """Install (or clear, with None) this thread's stage sink."""
+    _tls.sink = sink
+
+
+def get_sink():
+    return getattr(_tls, "sink", None)
+
+
+@contextmanager
+def stage(name: str):
+    """Time a block into the current sink (no-op without a sink).
+    Repeated stages accumulate."""
+    sink = getattr(_tls, "sink", None)
+    if sink is None:
+        yield
+        return
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        sink[name] = round(
+            sink.get(name, 0.0) + (time.perf_counter() - t0) * 1000, 3
+        )

@@ -20,6 +20,7 @@ from dss_tpu.dar.store import RIDStore
 from dss_tpu.geo import covering as geo_covering
 from dss_tpu.models import rid as ridm
 from dss_tpu.models.core import Version, validate_uuid
+from dss_tpu.obs import stages
 from dss_tpu.services import serialization as ser
 
 MAX_SUBSCRIPTIONS_PER_AREA = 10  # DSS0030 (pkg/rid/application/subscription.go)
@@ -74,7 +75,8 @@ class RIDService:
             id=id, owner=owner, url=flights_url, version=version
         )
         try:
-            isa.set_extents(ser.volume4d_from_rid_json(extents_json))
+            with stages.stage("covering_ms"):
+                isa.set_extents(ser.volume4d_from_rid_json(extents_json))
         except geo_covering.AreaTooLargeError as e:
             raise errors.area_too_large(f"bad extents: {e}")
         except geo_covering.BadAreaError as e:
@@ -145,7 +147,8 @@ class RIDService:
         earliest_time: Optional[str] = None,
         latest_time: Optional[str] = None,
     ) -> dict:
-        cells = _area_to_cells(area or "")
+        with stages.stage("covering_ms"):
+            cells = _area_to_cells(area or "")
         earliest = latest = None
         if earliest_time:
             try:
@@ -161,8 +164,10 @@ class RIDService:
         now = self.clock.now()
         if earliest is None or earliest < now:
             earliest = now
-        isas = self.store.search_isas(cells, earliest, latest)
-        return {"service_areas": [ser.isa_to_json(i) for i in isas]}
+        with stages.stage("store_ms"):
+            isas = self.store.search_isas(cells, earliest, latest)
+        with stages.stage("serialize_ms"):
+            return {"service_areas": [ser.isa_to_json(i) for i in isas]}
 
     # -- Subscriptions (subscription_handler.go + application/subscription.go)
 
@@ -193,7 +198,8 @@ class RIDService:
             version=version,
         )
         try:
-            sub.set_extents(ser.volume4d_from_rid_json(extents_json))
+            with stages.stage("covering_ms"):
+                sub.set_extents(ser.volume4d_from_rid_json(extents_json))
         except geo_covering.AreaTooLargeError as e:
             raise errors.area_too_large(f"bad extents: {e}")
         except geo_covering.BadAreaError as e:
@@ -257,6 +263,9 @@ class RIDService:
         return {"subscription": ser.rid_sub_to_json(deleted)}
 
     def search_subscriptions(self, area: str, owner: str) -> dict:
-        cells = _area_to_cells(area or "")
-        subs = self.store.search_subscriptions_by_owner(cells, owner)
-        return {"subscriptions": [ser.rid_sub_to_json(s) for s in subs]}
+        with stages.stage("covering_ms"):
+            cells = _area_to_cells(area or "")
+        with stages.stage("store_ms"):
+            subs = self.store.search_subscriptions_by_owner(cells, owner)
+        with stages.stage("serialize_ms"):
+            return {"subscriptions": [ser.rid_sub_to_json(s) for s in subs]}

@@ -22,6 +22,7 @@ from dss_tpu.geo import covering as geo_covering
 from dss_tpu.models import scd as scdm
 from dss_tpu.models.core import validate_uss_base_url
 from dss_tpu.models.volumes import union_volumes_4d
+from dss_tpu.obs import stages
 from dss_tpu.services import serialization as ser
 
 
@@ -71,7 +72,8 @@ class SCDService:
         if u_extent.end_time is None:
             raise errors.bad_request("missing time_end from extents")
         try:
-            cells = u_extent.calculate_spatial_covering()
+            with stages.stage("covering_ms"):
+                cells = u_extent.calculate_spatial_covering()
         except (
             geo_covering.AreaTooLargeError,
             geo_covering.BadAreaError,
@@ -184,7 +186,8 @@ class SCDService:
             raise errors.bad_request("missing area_of_interest")
         vol4 = ser.volume4d_from_scd_json(aoi)
         try:
-            cells = vol4.calculate_spatial_covering()
+            with stages.stage("covering_ms"):
+                cells = vol4.calculate_spatial_covering()
         except (
             geo_covering.AreaTooLargeError,
             geo_covering.BadAreaError,
@@ -266,7 +269,8 @@ class SCDService:
             raise errors.bad_request("missing area_of_interest")
         vol4 = ser.volume4d_from_scd_json(aoi)
         try:
-            cells = vol4.calculate_spatial_covering()
+            with stages.stage("covering_ms"):
+                cells = vol4.calculate_spatial_covering()
         except (
             geo_covering.AreaTooLargeError,
             geo_covering.BadAreaError,

@@ -132,6 +132,59 @@ def test_area_gate_falls_back():
     assert native.loop_covering(loop.v, area_ok=False) is None
 
 
+def test_points_covering_full_path_differential():
+    """dss_points_covering (winding retry + area gate + rect) vs the
+    pure-Python covering_from_loop_points internals."""
+    rng = np.random.default_rng(99)
+    checked = 0
+    for _ in range(80):
+        pts = _rand_small_polygon(rng)
+        if rng.random() < 0.5:
+            pts = pts[::-1]  # CW input exercises the winding retry
+        xyz = np.asarray([latlng_to_xyz(la, ln) for la, ln in pts])
+        try:
+            got = native.points_covering(xyz, MAX_AREA_KM2)
+        except native.AreaTooLarge:
+            got = "too_large"
+        except native.Degenerate:
+            got = "degenerate"
+        if got is None:
+            continue
+        # python reference (bypassing the native dispatch)
+        loop = Loop(xyz)
+        area = loop_area_km2(loop)
+        if area > MAX_AREA_KM2:
+            loop = Loop(xyz[::-1])
+            area = loop_area_km2(loop)
+        if area > MAX_AREA_KM2:
+            want = "too_large"
+        elif area <= 0:
+            want = "degenerate"
+        else:
+            want = _numpy_loop_covering(loop)
+        if isinstance(want, str) or isinstance(got, str):
+            assert got == want if isinstance(want, str) else False
+        else:
+            np.testing.assert_array_equal(got, want)
+        checked += 1
+    assert checked > 50
+
+
+def test_points_covering_area_gate_and_message():
+    # a ~60 km square: over the 2500 km2 gate in BOTH windings
+    pts = [(0.0, 0.0), (0.0, 1.0), (1.0, 1.0), (1.0, 0.0)]
+    xyz = np.asarray([latlng_to_xyz(la, ln) for la, ln in pts])
+    import dss_tpu.geo.covering as C
+
+    try:
+        C.covering_from_loop_points(xyz)
+        raised = False
+    except C.AreaTooLargeError as e:
+        raised = True
+        assert "area is too large" in str(e)
+    assert raised
+
+
 def test_polygon_end_to_end_matches_bfs():
     # full covering_polygon path (native engaged) vs forced-BFS result
     pts = [(37.0, -122.0), (37.05, -122.0), (37.05, -122.05), (37.0, -122.05)]
