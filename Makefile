@@ -25,6 +25,7 @@ bench:
 
 bench-all: bench
 	$(PY) benchmarks/bench_rid_search.py
+	$(PY) benchmarks/bench_scd_write.py
 	$(PY) benchmarks/bench_fanout.py
 	$(PY) benchmarks/bench_sharded_replay.py
 

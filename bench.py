@@ -270,6 +270,77 @@ def serving_leg(table, n_cells, width, threads, warm_s, run_s):
     }
 
 
+def curve_leg(table, n_cells, width, rates, secs, warm_s=1.0):
+    """Open-loop qps/latency curve (VERDICT r4 #3): drive the serving
+    path at FIXED offered rates and report achieved qps + p50/p99
+    measured from the SCHEDULED send time (coordinated omission safe).
+    The north-star claim is then stated jointly: the max offered load
+    at which p50 stays under 5 ms."""
+    co = QueryCoalescer(table)
+    rows = []
+    for offered in rates:
+        k = int(min(16, max(4, offered // 500)))
+        per_thread = offered / k
+        stop_at = time.perf_counter() + warm_s + secs
+        warm_until = time.perf_counter() + warm_s
+        lats: list = [[] for _ in range(k)]
+
+        def client(i):
+            r = np.random.default_rng(5000 + i)
+            interval = 1.0 / per_thread
+            next_t = time.perf_counter() + r.uniform(0, interval)
+            while True:
+                now_t = time.perf_counter()
+                if now_t >= stop_at:
+                    return
+                if now_t < next_t:
+                    time.sleep(min(next_t - now_t, 0.02))
+                    continue
+                start = int(r.integers(0, n_cells - width))
+                keys = (start + np.arange(width)).astype(np.int32)
+                alo = float(r.uniform(0, 3000))
+                t0 = NOW + int(r.integers(-2, 2)) * HOUR
+                co.query(keys, alo, alo + 300.0, t0, t0 + HOUR, now=NOW)
+                done = time.perf_counter()
+                if done >= warm_until:
+                    # latency from the scheduled send time: queueing
+                    # delay when we fall behind the offered rate counts
+                    lats[i].append(done - next_t)
+                next_t += interval
+
+        ths = [
+            threading.Thread(target=client, args=(i,)) for i in range(k)
+        ]
+        t_run0 = time.perf_counter()
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+        span = time.perf_counter() - t_run0 - warm_s
+        all_l = np.sort(np.concatenate([np.asarray(x) for x in lats]))
+        if len(all_l) == 0:
+            continue
+        row = {
+            "offered_qps": offered,
+            "achieved_qps": round(len(all_l) / max(span, 1e-9), 1),
+            "p50_ms": round(float(all_l[len(all_l) // 2]) * 1000, 2),
+            "p99_ms": round(
+                float(all_l[int(len(all_l) * 0.99)]) * 1000, 2
+            ),
+            "threads": k,
+        }
+        rows.append(row)
+        if row["p50_ms"] > 50 or row["achieved_qps"] < offered * 0.5:
+            break  # saturated; higher rates only melt further
+    co.close()
+    ok = [
+        r["offered_qps"]
+        for r in rows
+        if r["p50_ms"] < 5.0 and r["achieved_qps"] >= r["offered_qps"] * 0.9
+    ]
+    return rows, (max(ok) if ok else 0)
+
+
 def main():
     n_entities = int(os.environ.get("DSS_BENCH_ENTITIES", 1_000_000))
     n_cells = int(os.environ.get("DSS_BENCH_CELLS", 200_000))
@@ -316,6 +387,20 @@ def main():
             for k, v in serving.items()
         }
 
+    curve = None
+    max_ok = None
+    if do_serving and os.environ.get("DSS_BENCH_CURVE", "1") != "0":
+        rates = [
+            int(x)
+            for x in os.environ.get(
+                "DSS_BENCH_CURVE_RATES", "500,1000,2000,4000,8000,12000"
+            ).split(",")
+        ]
+        curve, max_ok = curve_leg(
+            table, n_cells, width, rates,
+            secs=float(os.environ.get("DSS_BENCH_CURVE_SECS", 3.0)),
+        )
+
     qps = h["qps"]
     result = {
         "metric": "scd_conflict_qps_1M_intents",
@@ -333,6 +418,13 @@ def main():
             "warmup_hits_per_query": round(h["warmup_hits_per_query"], 1),
             "dispatch_floor_ms": round(floor_ms, 2),
             "serving": serving,
+            # the north-star claim, stated jointly and honestly:
+            # batched pipeline sustains `value` qps; the serving path
+            # holds p50 < 5 ms up to max_serving_qps_p50_under_5ms
+            # offered load on this host (single core + tunneled TPU —
+            # see dispatch_floor_ms)
+            "qps_latency_curve": curve,
+            "max_serving_qps_p50_under_5ms": max_ok,
             "backend": jax.devices()[0].platform,
             "device": str(jax.devices()[0]),
             "pipeline": "DarTable snapshot; fused: host-searchsorted +"

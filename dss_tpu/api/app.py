@@ -373,20 +373,31 @@ def build_app(
         core hosts), runs directly on the event loop: reads are
         lock-free against the immutable store state and take ~0.3 ms,
         so on one core the two executor handoffs are pure overhead.
-        Multi-core deployments keep the executor (loop stays free)."""
+
+        Inline execution is OPTIMISTIC under a host-only budget: any
+        path that would dispatch to the device, trigger an XLA
+        compile, or block behind another thread's batch raises
+        NeedsDevice, and the (pure) read re-runs on the executor —
+        the loop never stalls on device work.  Multi-core deployments
+        keep the executor throughout."""
         if not inline_reads or not _native_ready():
             # without the native covering kernel a search can fall back
             # to a multi-ms numpy BFS — keep that off the event loop
             return await _call(fn, *args, request=request)
+        from dss_tpu.dar import budget as _budget
         from dss_tpu.obs import stages as _stages
 
         sink = request.get("dss_stages")
         t0 = time.perf_counter()
         if sink is not None:
             _stages.set_sink(sink)
+        _budget.set_host_only(True)
         try:
             return fn(*args)
+        except _budget.NeedsDevice:
+            return await _call(fn, *args, request=request)
         finally:
+            _budget.set_host_only(False)
             if sink is not None:
                 _stages.set_sink(None)
                 sink["service_ms"] = round(
@@ -508,8 +519,35 @@ def build_app(
         def _now_ns_fn():
             return int(_time.time() * 1e9)
 
-        async def replica_search_ops(request):
-            auth(request, _AUX + "ReplicaSearchOperations")
+        # URL segment -> (replica class, auth operation, response key)
+        replica_surfaces = {
+            "operations": (
+                "ops", _AUX + "ReplicaSearchOperations", "operation_ids"
+            ),
+            "identification_service_areas": (
+                "isas",
+                _RID + "SearchIdentificationServiceAreas",
+                "service_area_ids",
+            ),
+            "subscriptions": (
+                "rid_subs", _RID + "SearchSubscriptions",
+                "subscription_ids",
+            ),
+            "scd_subscriptions": (
+                "scd_subs", _SCD + "QuerySubscriptions",
+                "subscription_ids",
+            ),
+        }
+
+        async def replica_search(request):
+            surface = replica_surfaces.get(request.match_info["surface"])
+            if surface is None:
+                raise errors.bad_request(
+                    "unknown replica surface; one of: "
+                    + ", ".join(sorted(replica_surfaces))
+                )
+            cls, operation, out_key = surface
+            auth(request, operation)
             area = request.query.get("area", "")
             try:
                 cells = geo_covering.area_to_cell_ids(area)
@@ -539,7 +577,7 @@ def build_app(
                 except ValueError:
                     raise errors.bad_request(f"bad {name}: {raw!r}")
 
-            ids = await _call_r(request, 
+            ids = await _call_r(request,
                 functools.partial(
                     replica.query,
                     keys,
@@ -548,14 +586,15 @@ def build_app(
                     parse_t("earliest_time"),
                     parse_t("latest_time"),
                     now=_now_ns_fn(),
+                    cls=cls,
                 )
             )
             return web.json_response(
-                {"operation_ids": ids, "replica": replica.stats()}
+                {out_key: ids, "replica": replica.stats()}
             )
 
         app.router.add_get(
-            "/aux/v1/replica/operations", replica_search_ops
+            "/aux/v1/replica/{surface}", replica_search
         )
 
     # -- RID -----------------------------------------------------------------

@@ -229,7 +229,7 @@ def build_worker(args) -> web.Application:
     rid = RIDService(store.rid, clock)
     scd = SCDService(store.scd, clock) if args.enable_scd else None
     authorizer = _make_authorizer(args)
-    metrics = MetricsRegistry()
+    metrics = MetricsRegistry(proc=f"worker:{os.getpid()}")
     from dss_tpu.build_info import build_info
 
     metrics.set_info("dss_build_info", build_info())
@@ -369,7 +369,9 @@ def build(args) -> web.Application:
 
     authorizer = _make_authorizer(args)
 
-    metrics = MetricsRegistry()
+    metrics = MetricsRegistry(
+        proc=f"leader:{os.getpid()}" if args.workers > 0 else None
+    )
     metrics.set_info("dss_build_info", build_info())
 
     replica = None
@@ -377,7 +379,7 @@ def build(args) -> web.Application:
         import jax
         import numpy as _np
 
-        from dss_tpu.parallel.replica import ShardedOpReplica
+        from dss_tpu.parallel.replica import ShardedReplica
         from jax.sharding import Mesh
 
         try:
@@ -399,7 +401,7 @@ def build(args) -> web.Application:
         if args.region_url:
             from dss_tpu.region.client import RegionClient
 
-            replica = ShardedOpReplica(
+            replica = ShardedReplica(
                 mesh,
                 region_client=RegionClient(
                     args.region_url,
@@ -408,15 +410,18 @@ def build(args) -> web.Application:
                 ),
             )
         elif args.wal_path:
-            replica = ShardedOpReplica(mesh, wal_path=args.wal_path)
+            replica = ShardedReplica(mesh, wal_path=args.wal_path)
         else:
             raise SystemExit(
                 "--sharded_replica needs --wal_path or --region_url "
                 "(a log to tail)"
             )
         replica.start(args.replica_refresh_interval)
+        # oversized bounded-staleness search batches ride the mesh
+        store.attach_mesh_replica(replica)
         log.info(
-            "sharded replica serving on a %dx%d mesh (%s)",
+            "sharded replica serving all entity classes on a %dx%d "
+            "mesh (%s)",
             dp, sp, "region log" if args.region_url else "wal",
         )
 

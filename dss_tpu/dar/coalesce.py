@@ -23,6 +23,7 @@ from typing import List, Optional
 
 import numpy as np
 
+from dss_tpu.dar import budget
 from dss_tpu.ops.conflict import NO_TIME_HI, NO_TIME_LO
 
 _MAX_BATCH = 4096
@@ -30,9 +31,10 @@ _MAX_BATCH = 4096
 
 class _Item:
     __slots__ = ("keys", "alt_lo", "alt_hi", "t_start", "t_end", "now",
-                 "owner_id", "event", "result", "error")
+                 "owner_id", "allow_stale", "event", "result", "error")
 
-    def __init__(self, keys, alt_lo, alt_hi, t_start, t_end, now, owner_id):
+    def __init__(self, keys, alt_lo, alt_hi, t_start, t_end, now, owner_id,
+                 allow_stale=False):
         self.keys = keys
         self.alt_lo = -np.inf if alt_lo is None else float(alt_lo)
         self.alt_hi = np.inf if alt_hi is None else float(alt_hi)
@@ -40,6 +42,7 @@ class _Item:
         self.t_end = NO_TIME_HI if t_end is None else int(t_end)
         self.now = int(now)
         self.owner_id = -1 if owner_id is None else int(owner_id)
+        self.allow_stale = bool(allow_stale)
         self.event = threading.Event()
         self.result: Optional[List[str]] = None
         self.error: Optional[BaseException] = None
@@ -55,6 +58,22 @@ class QueryCoalescer:
         self._closed = False
         self._busy = False  # a batch is executing on the worker
         self._thread: Optional[threading.Thread] = None
+        # optional multi-chip offload: big read-only batches can run on
+        # a fresh ShardedReplica mesh instead of the local device
+        self._mesh_fn = None
+        self._mesh_fresh = None
+        self._mesh_min = 64
+        self.mesh_offloads = 0
+
+    def set_mesh_delegate(self, fn, fresh_fn, min_batch: int = 64):
+        """Route batches of >= min_batch bounded-staleness queries
+        (every item flagged allow_stale, no owner filters) to `fn`
+        (the ShardedReplica mesh) when fresh_fn() says the replica is
+        caught up.  Conflict prechecks never set allow_stale, so
+        correctness-critical reads always hit the local table."""
+        self._mesh_fn = fn
+        self._mesh_fresh = fresh_fn
+        self._mesh_min = min_batch
 
     def _ensure_thread(self):
         if self._thread is None or not self._thread.is_alive():
@@ -73,12 +92,16 @@ class QueryCoalescer:
         *,
         now: int,
         owner_id=None,
+        allow_stale: bool = False,
     ) -> List[str]:
         """Blocking single query, executed as part of a micro-batch."""
         keys = np.asarray(keys, np.int32).ravel()
         if len(keys) == 0:
             return []
-        item = _Item(keys, alt_lo, alt_hi, t_start, t_end, now, owner_id)
+        item = _Item(
+            keys, alt_lo, alt_hi, t_start, t_end, now, owner_id,
+            allow_stale,
+        )
         inline = False
         with self._cond:
             if self._closed:
@@ -92,6 +115,11 @@ class QueryCoalescer:
                 self._busy = True
                 inline = True
             else:
+                if budget.is_host_only():
+                    # event-loop caller would block in event.wait()
+                    # behind another thread's (possibly compiling)
+                    # batch: bounce to the executor path instead
+                    raise budget.NeedsDevice()
                 self._queue.append(item)
                 self._ensure_thread()
                 self._cond.notify()
@@ -144,6 +172,36 @@ class QueryCoalescer:
     def _execute(self, batch: List[_Item]):
         try:
             b = len(batch)
+            if (
+                self._mesh_fn is not None
+                and b >= self._mesh_min
+                and all(
+                    it.allow_stale and it.owner_id < 0 for it in batch
+                )
+                and self._mesh_fresh()
+            ):
+                try:
+                    results = self._mesh_fn(
+                        [it.keys for it in batch],
+                        np.asarray([it.alt_lo for it in batch], np.float32),
+                        np.asarray([it.alt_hi for it in batch], np.float32),
+                        np.asarray(
+                            [it.t_start for it in batch], np.int64
+                        ),
+                        np.asarray([it.t_end for it in batch], np.int64),
+                        np.asarray([it.now for it in batch], np.int64),
+                    )
+                    self.mesh_offloads += 1
+                    for it, res in zip(batch, results):
+                        it.result = res
+                        it.event.set()
+                    return
+                except Exception:  # noqa: BLE001 — fall back local
+                    import logging
+
+                    logging.getLogger("dss.dar").exception(
+                        "mesh offload failed; serving batch locally"
+                    )
             results = self._table.query_many(
                 [it.keys for it in batch],
                 np.asarray([it.alt_lo for it in batch], np.float32),

@@ -56,6 +56,7 @@ class MemorySpatialIndex:
         *,
         now,
         owner_id=None,
+        allow_stale=False,  # no replica tier here; same-freshness reads
     ) -> List[str]:
         keys = _to_keys(cells_u64)
         recs = {i: r for i, r in enumerate(self._recs.values())}
@@ -98,6 +99,7 @@ class TpuSpatialIndex:
         *,
         now,
         owner_id=None,
+        allow_stale=False,
     ) -> List[str]:
         return self._coalescer.query(
             _to_keys(cells_u64),
@@ -107,6 +109,7 @@ class TpuSpatialIndex:
             None if t_end is None else int(t_end),
             now=int(now),
             owner_id=owner_id,
+            allow_stale=allow_stale,
         )
 
     def max_owner_count(self, cells_u64, owner_id, *, now) -> int:
@@ -115,8 +118,18 @@ class TpuSpatialIndex:
         )
 
     def stats(self) -> dict:
-        return self._table.stats()
+        out = self._table.stats()
+        out["mesh_offloads"] = self._coalescer.mesh_offloads
+        return out
 
     @property
     def table(self) -> DarTable:
         return self._table
+
+    @property
+    def coalescer(self) -> QueryCoalescer:
+        return self._coalescer
+
+    def close(self):
+        self._coalescer.close()
+        self._table.close()

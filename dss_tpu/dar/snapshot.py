@@ -15,7 +15,10 @@ immutable `_State` object, swapped atomically by reference assignment:
     slot->id/owner maps.  Device/host arrays inside a snapshot are
     never mutated after publication.
   - `overlay`: records written since the snapshot build, packed into
-    small sorted numpy postings for a vectorized host scan.
+    small sorted numpy postings for a vectorized host scan.  Updated
+    O(Δ) per write: the new record's postings are spliced into copies
+    of the packed arrays (contiguous memcpy), never re-packed from the
+    record dicts (which cost O(overlay) python per write).
   - `dead`: snapshot slots superseded or removed since the build;
     readers drop them after the fused query.  (The FastTable's own
     mark_dead is NOT used here — mutating the shared live column would
@@ -26,9 +29,14 @@ an entity live at the time the reader grabbed the state is visible via
 exactly the snapshot or the overlay; an entity updated by a concurrent
 writer is visible as exactly one of its versions.
 
-When the overlay exceeds `delta_capacity` postings, the writer folds
-everything into a fresh snapshot (readers keep using the old state
-until the atomic swap).
+FOLDING (overlay -> snapshot) runs OFF the write lock: a folder thread
+copies the record list under the lock (O(n) pointer copy), builds the
+packed FastTable aside (the expensive part: pack + HBM upload), then
+swaps under the lock, reconciling the writes that landed mid-fold by
+object identity (they simply stay in the overlay of the new state).
+Folds trigger on overlay overflow (`delta_capacity` postings) and
+opportunistically when the table has been write-idle, so read-heavy
+phases serve from the snapshot path.
 
 Queries run the batched fused kernel; many concurrent requests are
 micro-batched by dss_tpu.dar.coalesce.QueryCoalescer.
@@ -37,10 +45,12 @@ micro-batched by dss_tpu.dar.coalesce.QueryCoalescer.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, List, NamedTuple, Optional
 
 import numpy as np
 
+from dss_tpu.dar import budget
 from dss_tpu.dar.oracle import Record
 from dss_tpu.dar.pack import pack_records, pow2_at_least
 from dss_tpu.ops.conflict import NO_TIME_HI, NO_TIME_LO
@@ -58,7 +68,8 @@ class _Snapshot(NamedTuple):
 
 class _Overlay(NamedTuple):
     """Records since the snapshot build, packed for a vectorized scan
-    (the host-side analog of the device postings layout)."""
+    (the host-side analog of the device postings layout).  Arrays are
+    immutable once published; writers splice copies."""
 
     ids: List[str]  # local index -> entity_id
     key: np.ndarray  # i32[P] sorted
@@ -104,6 +115,69 @@ def _pack_overlay(pending: Dict[str, Record]) -> Optional[_Overlay]:
     )
 
 
+def _overlay_upsert(
+    ov: Optional[_Overlay], rec: Record, idx: Optional[int]
+) -> "tuple[_Overlay, int]":
+    """O(Δ) overlay update: splice the record's postings into copies of
+    the packed arrays (contiguous memcpy, not a python repack).
+    `idx` is the record's existing local index (update) or None (new).
+    Returns (new_overlay, local_index)."""
+    k = np.asarray(rec.keys, np.int32)
+    if ov is None:
+        return (
+            _Overlay(
+                ids=[rec.entity_id],
+                key=k.copy(),
+                ent=np.zeros(len(k), np.int32),
+                alt_lo=np.asarray([rec.alt_lo], np.float32),
+                alt_hi=np.asarray([rec.alt_hi], np.float32),
+                t0=np.asarray([rec.t_start], np.int64),
+                t1=np.asarray([rec.t_end], np.int64),
+                owner=np.asarray([rec.owner_id], np.int32),
+            ),
+            0,
+        )
+    if idx is None:
+        idx = len(ov.ids)
+        ids = ov.ids + [rec.entity_id]
+        alt_lo = np.append(ov.alt_lo, np.float32(rec.alt_lo))
+        alt_hi = np.append(ov.alt_hi, np.float32(rec.alt_hi))
+        t0 = np.append(ov.t0, np.int64(rec.t_start))
+        t1 = np.append(ov.t1, np.int64(rec.t_end))
+        owner = np.append(ov.owner, np.int32(rec.owner_id))
+        key, ent = ov.key, ov.ent
+    else:
+        ids = ov.ids
+        alt_lo = ov.alt_lo.copy()
+        alt_lo[idx] = rec.alt_lo
+        alt_hi = ov.alt_hi.copy()
+        alt_hi[idx] = rec.alt_hi
+        t0 = ov.t0.copy()
+        t0[idx] = rec.t_start
+        t1 = ov.t1.copy()
+        t1[idx] = rec.t_end
+        owner = ov.owner.copy()
+        owner[idx] = rec.owner_id
+        keep = ov.ent != idx
+        key, ent = ov.key[keep], ov.ent[keep]
+    pos = np.searchsorted(key, k)
+    key = np.insert(key, pos, k)
+    ent = np.insert(ent, pos, np.full(len(k), idx, np.int32))
+    return (
+        _Overlay(ids, key, ent, alt_lo, alt_hi, t0, t1, owner),
+        idx,
+    )
+
+
+def _overlay_drop(ov: _Overlay, idx: int) -> Optional[_Overlay]:
+    """Remove a record's postings (its attr slot stays, orphaned —
+    bounded by the fold threshold)."""
+    keep = ov.ent != idx
+    if not keep.any() and len(ov.ids) == 1:
+        return None
+    return ov._replace(key=ov.key[keep], ent=ov.ent[keep])
+
+
 def _overlay_search(
     ov: _Overlay,
     qkeys: np.ndarray,  # i32[B, W] pad -1
@@ -138,7 +212,8 @@ def _overlay_search(
 
 class DarTable:
     """HBM spatial index for one entity class: lock-free reads against
-    the published immutable state; copy-on-write writes."""
+    the published immutable state; copy-on-write writes; background
+    folds."""
 
     def __init__(
         self,
@@ -148,12 +223,29 @@ class DarTable:
         delta_capacity: int = 8192,
         entity_capacity: int = 1024,  # kept for API compat; slots are
         #                               assigned per snapshot build
+        idle_fold_s: float = 0.5,  # fold the overlay after this long
+        #                            without writes (0 disables)
     ):
         del max_results, entity_capacity
         self._write_lock = threading.RLock()
         self._rebuild_postings = delta_capacity
         self.records: Dict[str, Record] = {}  # authoritative, writer-owned
         self._state: _State = _EMPTY_STATE
+        # writer-owned overlay index (id -> local idx in the overlay);
+        # reset on every fold/rebuild.  Readers never touch it.
+        self._overlay_idx: Dict[str, int] = {}
+        # background folding
+        self._idle_fold_s = idle_fold_s
+        self._gen = 0  # bumped by synchronous rebuilds: abandons folds
+        self._folding = False
+        self._fold_removed: List[str] = []  # ids removed mid-fold
+        self._fold_event = threading.Event()
+        self._fold_thread: Optional[threading.Thread] = None
+        self._last_write = 0.0
+        self._closed = False
+        self._stats_folds = 0
+        self._stats_fold_ms = 0.0
+        self._stats_swap_ms = 0.0
 
     # -- write path ----------------------------------------------------------
 
@@ -185,13 +277,17 @@ class DarTable:
             pending[entity_id] = rec
             slot = st.snap.slot_of.get(entity_id)
             dead = st.dead if slot is None else st.dead | {slot}
-            if sum(len(r.keys) for r in pending.values()) > self._rebuild_postings:
-                self._rebuild_locked()
-                return
-            # one atomic publish: snapshot + overlay + dead set together
-            self._state = _State(
-                st.snap, pending, _pack_overlay(pending), dead
+            overlay, idx = _overlay_upsert(
+                st.overlay, rec, self._overlay_idx.get(entity_id)
             )
+            self._overlay_idx[entity_id] = idx
+            # one atomic publish: snapshot + overlay + dead set together
+            self._state = _State(st.snap, pending, overlay, dead)
+            self._last_write = time.monotonic()
+            if len(overlay.key) > self._rebuild_postings:
+                self._request_fold()
+            elif self._idle_fold_s > 0:
+                self._ensure_folder()  # idle compaction needs the thread
 
     def remove(self, entity_id: str) -> bool:
         with self._write_lock:
@@ -200,49 +296,167 @@ class DarTable:
                 return False
             st = self._state
             pending = st.pending
+            overlay = st.overlay
             if entity_id in pending:
                 pending = dict(pending)
                 del pending[entity_id]
+                idx = self._overlay_idx.pop(entity_id, None)
+                if overlay is not None and idx is not None:
+                    overlay = _overlay_drop(overlay, idx)
             slot = st.snap.slot_of.get(entity_id)
             dead = st.dead if slot is None else st.dead | {slot}
-            self._state = _State(
-                st.snap, pending, _pack_overlay(pending), dead
-            )
+            if self._folding:
+                self._fold_removed.append(entity_id)
+            self._state = _State(st.snap, pending, overlay, dead)
+            self._last_write = time.monotonic()
             return True
 
-    def _rebuild_locked(self):
-        """Fold records into a fresh device snapshot and publish it."""
-        live = list(self.records.values())
+    # -- folding (overlay -> snapshot), off the write lock -------------------
+
+    def _ensure_folder(self):
+        if self._fold_thread is None or not self._fold_thread.is_alive():
+            self._fold_thread = threading.Thread(
+                target=self._fold_loop, name="dar-folder", daemon=True
+            )
+            self._fold_thread.start()
+
+    def _request_fold(self):
+        self._ensure_folder()
+        self._fold_event.set()
+
+    def close(self):
+        """Stop the folder thread (tables created in tests/benchmarks
+        must not leak a wake-every-idle_fold_s daemon each)."""
+        self._closed = True
+        self._fold_event.set()
+        th = self._fold_thread
+        if th is not None and th is not threading.current_thread():
+            th.join(timeout=5)
+
+    def _fold_loop(self):
+        while not self._closed:
+            triggered = self._fold_event.wait(
+                self._idle_fold_s if self._idle_fold_s > 0 else None
+            )
+            self._fold_event.clear()
+            if self._closed:
+                return
+            try:
+                if triggered:
+                    self.fold()
+                else:
+                    # idle compaction: fold a quiet non-empty overlay so
+                    # read-heavy phases serve from the snapshot path
+                    st = self._state
+                    if (st.pending or st.dead) and (
+                        time.monotonic() - self._last_write
+                        >= self._idle_fold_s
+                    ):
+                        self.fold()
+            except Exception:  # noqa: BLE001 — folder must survive
+                import logging
+
+                logging.getLogger("dss.dar").exception("fold failed")
+
+    def fold(self) -> bool:
+        """Fold records into a fresh snapshot OFF the write lock; swap
+        atomically, keeping mid-fold writes in the new overlay.  -> True
+        if a new snapshot was published."""
+        t_all = time.perf_counter()
+        with self._write_lock:
+            if self._folding:
+                return False  # a fold is already running
+            if not self._state.pending and not self._state.dead:
+                return False  # nothing new to fold
+            self._folding = True
+            self._fold_removed = []
+            gen0 = self._gen
+            recs = list(self.records.values())  # O(n) pointer copy
+        try:
+            snap = self._build_snapshot(recs)  # pack + HBM upload, unlocked
+            t_swap = time.perf_counter()
+            with self._write_lock:
+                if self._gen != gen0:
+                    return False  # a synchronous rebuild superseded us
+                built = snap.recs
+                cur = self._state
+                # writes that landed mid-fold: record object differs
+                # from what we built (or is brand new)
+                new_pending = {
+                    i: r
+                    for i, r in cur.pending.items()
+                    if built.get(i) is not r
+                }
+                dead = set()
+                for i in new_pending:
+                    s = snap.slot_of.get(i)
+                    if s is not None:
+                        dead.add(s)
+                for i in self._fold_removed:
+                    s = snap.slot_of.get(i)
+                    if s is not None:
+                        dead.add(s)
+                overlay = _pack_overlay(new_pending)
+                self._overlay_idx = {
+                    i: k for k, i in enumerate(new_pending)
+                }
+                self._state = _State(
+                    snap, new_pending, overlay, frozenset(dead)
+                )
+                self._stats_swap_ms += (
+                    time.perf_counter() - t_swap
+                ) * 1000
+            self._stats_folds += 1
+            self._stats_fold_ms += (time.perf_counter() - t_all) * 1000
+            return True
+        finally:
+            with self._write_lock:
+                self._folding = False
+                self._fold_removed = []
+
+    @staticmethod
+    def _build_snapshot(live: List[Record]) -> _Snapshot:
         if not live:
-            snap = _EMPTY_SNAPSHOT
-        else:
-            packed = pack_records(live, pad_postings=False)
-            pe = packed.post_ent
-            ft = FastTable(
-                packed.post_key,
-                pe,
-                packed.alt_lo[pe],
-                packed.alt_hi[pe],
-                packed.t_start[pe],
-                packed.t_end[pe],
-                packed.active[pe],
-                slot_exact={
-                    "alt_lo": packed.alt_lo,
-                    "alt_hi": packed.alt_hi,
-                    "t0": packed.t_start,
-                    "t1": packed.t_end,
-                    "live": packed.active.copy(),
-                },
-            )
-            ids = [r.entity_id for r in live]
-            snap = _Snapshot(
-                fast=ft,
-                owner=packed.owner,
-                ids=ids,
-                slot_of={eid: i for i, eid in enumerate(ids)},
-                recs={r.entity_id: r for r in live},
-            )
-        self._state = _State(snap, {}, None, frozenset())
+            return _EMPTY_SNAPSHOT
+        packed = pack_records(live, pad_postings=False)
+        pe = packed.post_ent
+        ft = FastTable(
+            packed.post_key,
+            pe,
+            packed.alt_lo[pe],
+            packed.alt_hi[pe],
+            packed.t_start[pe],
+            packed.t_end[pe],
+            packed.active[pe],
+            slot_exact={
+                "alt_lo": packed.alt_lo,
+                "alt_hi": packed.alt_hi,
+                "t0": packed.t_start,
+                "t1": packed.t_end,
+                "live": packed.active.copy(),
+            },
+        )
+        ids = [r.entity_id for r in live]
+        return _Snapshot(
+            fast=ft,
+            owner=packed.owner,
+            ids=ids,
+            slot_of={eid: i for i, eid in enumerate(ids)},
+            recs={r.entity_id: r for r in live},
+        )
+
+    def _rebuild_locked(self):
+        """Synchronous in-lock rebuild (bulk loads / explicit calls).
+        Bumps the generation so any in-flight background fold abandons
+        its (now stale) snapshot instead of swapping it in."""
+        self._gen += 1
+        self._state = _State(
+            self._build_snapshot(list(self.records.values())),
+            {},
+            None,
+            frozenset(),
+        )
+        self._overlay_idx = {}
 
     def rebuild(self):
         with self._write_lock:
@@ -324,6 +538,9 @@ class DarTable:
                     now=now_arr, ranges=ranges,
                 )
             else:
+                if budget.is_host_only():
+                    # caller is on the event loop: re-run via executor
+                    raise budget.NeedsDevice()
                 qidx, slots = st.snap.fast.query_fused(
                     qkeys, alt_lo, alt_hi, t_start, t_end, now=now_arr
                 )
@@ -400,4 +617,7 @@ class DarTable:
             "snapshot_records": len(st.snap.ids),
             "pending_records": len(st.pending),
             "dead_slots": len(st.dead),
+            "folds": self._stats_folds,
+            "fold_ms_total": round(self._stats_fold_ms, 1),
+            "fold_swap_ms_total": round(self._stats_swap_ms, 3),
         }

@@ -15,6 +15,37 @@ import os
 import threading
 from typing import Callable, Iterator, Optional
 
+# Log format version.  A head record {"t": "__format__", "version": N}
+# gates boot: replaying a log written by an incompatible future format
+# must refuse loudly instead of rebuilding garbage state — the
+# reference's schema gate (MustSupportSchema,
+# /root/reference/cmds/grpc-backend/main.go:75-86,
+# pkg/rid/cockroach/store.go:165-187).  Logs predating versioning
+# (no head record) read as version 0, which is compatible.
+FORMAT_VERSION = 1
+
+
+class LogFormatError(RuntimeError):
+    """The log was written by an unsupported (newer) format."""
+
+
+def format_record() -> dict:
+    return {"t": "__format__", "version": FORMAT_VERSION}
+
+
+def check_format_record(rec: Optional[dict], path: str) -> None:
+    """Raise LogFormatError if the head record declares an unsupported
+    version.  rec=None (legacy headerless log) is accepted."""
+    if rec is None or rec.get("t") != "__format__":
+        return
+    v = rec.get("version", 0)
+    if not isinstance(v, int) or v > FORMAT_VERSION:
+        raise LogFormatError(
+            f"log {path} has format version {v}, but this binary "
+            f"supports <= {FORMAT_VERSION}; refusing to start "
+            "(upgrade the binary or restore a compatible log)"
+        )
+
 
 class WriteAheadLog:
     def __init__(self, path: Optional[str], fsync: bool = False):
@@ -26,11 +57,22 @@ class WriteAheadLog:
         self._fh = None
         if path is not None:
             os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-            # recover the sequence number from an existing log
-            if os.path.exists(path):
+            fresh = (
+                not os.path.exists(path) or os.path.getsize(path) == 0
+            )
+            if not fresh:
+                # recover the sequence number from an existing log;
+                # replay() itself gates on the head format record
                 for rec in self.replay():
                     self._seq = max(self._seq, rec.get("seq", 0))
             self._fh = open(path, "a", encoding="utf-8")
+            if fresh:
+                # header carries no seq: user records stay 1-based
+                self._fh.write(
+                    json.dumps(format_record(), separators=(",", ":"))
+                    + "\n"
+                )
+                self._fh.flush()
 
     @property
     def seq(self) -> int:
@@ -49,19 +91,28 @@ class WriteAheadLog:
             return self._seq
 
     def replay(self) -> Iterator[dict]:
-        """Yield records in order; tolerates a torn final line."""
+        """Yield records in order; tolerates a torn final line.  Raises
+        LogFormatError if the head record declares an unsupported
+        format (the boot gate)."""
         if self.path is None or not os.path.exists(self.path):
             return
+        first = True
         with open(self.path, "r", encoding="utf-8") as fh:
             for line in fh:
                 line = line.strip()
                 if not line:
                     continue
                 try:
-                    yield json.loads(line)
+                    rec = json.loads(line)
                 except json.JSONDecodeError:
                     # torn tail write (crash mid-append): stop replay here
                     return
+                if first:
+                    first = False
+                    check_format_record(rec, self.path)
+                if rec.get("t") == "__format__":
+                    continue  # gate metadata, not store state
+                yield rec
 
     def adopt(self, tmp_path: str, seq: int) -> None:
         """Swap a fully-written, fsynced replacement log into place:

@@ -54,7 +54,13 @@ def _esc_label(v) -> str:
 
 
 class MetricsRegistry:
-    def __init__(self):
+    def __init__(self, proc: str = None):
+        # proc: constant label stamped on every series.  REQUIRED in
+        # multi-process serving (--workers): the processes share one
+        # port via SO_REUSEPORT, so consecutive scrapes land on
+        # different processes' registries — without a distinguishing
+        # label the series would appear to reset on every scrape.
+        self._proc = proc
         self._lock = threading.Lock()
         self._counters: Dict[Tuple[str, str, int], int] = {}
         self._hist: Dict[Tuple[str, str], list] = {}
@@ -107,25 +113,35 @@ class MetricsRegistry:
         supplied), and one bad value must not invalidate the whole
         scrape."""
         lines = []
+        pl = (
+            "" if self._proc is None
+            else f'proc="{_esc_label(self._proc)}"'
+        )
+
+        def lab(extra: str) -> str:
+            if not pl:
+                return extra
+            return f"{extra},{pl}" if extra else pl
         with self._lock:
             for name, labels in sorted(self._infos.items()):
-                lab = ",".join(
+                l = ",".join(
                     f'{k}="{_esc_label(v)}"' for k, v in sorted(labels.items())
                 )
                 lines.append(f"# TYPE {name} gauge")
-                lines.append(f"{name}{{{lab}}} 1")
+                lines.append(f"{name}{{{lab(l)}}} 1")
             lines.append("# TYPE dss_requests_total counter")
             for (m, r, s), v in sorted(self._counters.items()):
-                lines.append(
-                    f'dss_requests_total{{method="{_esc_label(m)}",'
-                    f'route="{_esc_label(r)}",status="{s}"}} {v}'
+                l = (
+                    f'method="{_esc_label(m)}",'
+                    f'route="{_esc_label(r)}",status="{s}"'
                 )
+                lines.append(f"dss_requests_total{{{lab(l)}}} {v}")
             lines.append(
                 "# TYPE dss_request_duration_seconds histogram"
             )
             for hk in sorted(self._hist):
                 m, r = hk
-                lab = (
+                l = lab(
                     f'method="{_esc_label(m)}",route="{_esc_label(r)}"'
                 )
 
@@ -133,37 +149,40 @@ class MetricsRegistry:
                 for i, b in enumerate(BUCKETS):
                     cum = self._hist[hk][i]
                     lines.append(
-                        f"dss_request_duration_seconds_bucket{{{lab},"
+                        f"dss_request_duration_seconds_bucket{{{l},"
                         f'le="{b}"}} {cum}'
                     )
                 lines.append(
-                    f"dss_request_duration_seconds_bucket{{{lab},"
+                    f"dss_request_duration_seconds_bucket{{{l},"
                     f'le="+Inf"}} {self._hist_cnt[hk]}'
                 )
                 lines.append(
-                    f"dss_request_duration_seconds_sum{{{lab}}} "
+                    f"dss_request_duration_seconds_sum{{{l}}} "
                     f"{self._hist_sum[hk]:.6f}"
                 )
                 lines.append(
-                    f"dss_request_duration_seconds_count{{{lab}}} "
+                    f"dss_request_duration_seconds_count{{{l}}} "
                     f"{self._hist_cnt[hk]}"
                 )
             if self._stage_cnt:
                 lines.append("# TYPE dss_request_stage_seconds summary")
                 for k in sorted(self._stage_cnt):
                     r, st = k
-                    lab = (
+                    l = lab(
                         f'route="{_esc_label(r)}",stage="{_esc_label(st)}"'
                     )
                     lines.append(
-                        f"dss_request_stage_seconds_sum{{{lab}}} "
+                        f"dss_request_stage_seconds_sum{{{l}}} "
                         f"{self._stage_sum[k]:.6f}"
                     )
                     lines.append(
-                        f"dss_request_stage_seconds_count{{{lab}}} "
+                        f"dss_request_stage_seconds_count{{{l}}} "
                         f"{self._stage_cnt[k]}"
                     )
             for name, v in sorted(self._gauges.items()):
                 lines.append(f"# TYPE {name} gauge")
-                lines.append(f"{name} {v}")
+                if pl:
+                    lines.append(f"{name}{{{pl}}} {v}")
+                else:
+                    lines.append(f"{name} {v}")
         return "\n".join(lines) + "\n"

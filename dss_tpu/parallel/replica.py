@@ -1,17 +1,19 @@
-"""ShardedDar refresh: tail a durable log into a serving multi-chip
-read replica.
+"""ShardedDar refresh: tail a durable log into serving multi-chip
+read replicas — one per entity class.
 
 SURVEY §7 step 7 (second half): writes land in the single-chip store +
 WAL (or the region log in region mode); this replica tails that log and
-periodically folds it into a fresh `ShardedDar` snapshot on the device
-mesh, swapping it in atomically for readers — the same
+periodically folds each entity class (SCD operations, RID ISAs, RID
+subscriptions, SCD subscriptions) into a fresh `ShardedDar` snapshot on
+the device mesh, swapping it in atomically for readers — the same
 source-of-truth/read-replica split the reference gets from CRDB ranges
-(implementation_details.md:11-42).
+(implementation_details.md:11-42, where range sharding covers EVERY
+table).
 
 Consistency: readers grab ONE (dar, ids) snapshot reference per query,
 so a query always runs against a complete snapshot — concurrent
 refreshes are invisible until their atomic swap.  Staleness is bounded
-by the poll interval + rebuild time.
+by the poll interval + rebuild time and exposed via stats.
 
 Sources:
   - `wal_path`: tail a standalone server's WriteAheadLog file
@@ -26,6 +28,7 @@ import json
 import logging
 import os
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -38,13 +41,19 @@ from dss_tpu.parallel.sharded import ShardedDar
 
 log = logging.getLogger("dss.replica")
 
+# entity classes the replica serves (replica class name -> WAL prefix)
+CLASSES = ("ops", "isas", "rid_subs", "scd_subs")
+
 
 class _WalTail:
-    """Incremental reader of a WriteAheadLog file (JSON lines)."""
+    """Incremental reader of a WriteAheadLog file (JSON lines).
+    The first record is checked against the supported log format
+    (the same boot gate as WriteAheadLog.replay)."""
 
     def __init__(self, path: str):
         self.path = path
         self._offset = 0
+        self._checked_head = False
 
     def poll(self) -> List[dict]:
         if not os.path.exists(self.path):
@@ -66,12 +75,18 @@ class _WalTail:
                     self._offset = fh.tell()
                     continue
                 try:
-                    out.append(json.loads(line))
+                    rec = json.loads(line)
                 except json.JSONDecodeError:
                     # torn write that still got a newline: stop here
                     # and retry next poll
                     fh.seek(pos)
                     break
+                if not self._checked_head and pos == 0:
+                    from dss_tpu.dar import wal as _walmod
+
+                    _walmod.check_format_record(rec, self.path)
+                    self._checked_head = True
+                out.append(rec)
                 self._offset = fh.tell()
         return out
 
@@ -120,9 +135,15 @@ class _RegionTail:
             return out
 
 
-class ShardedOpReplica:
-    """SCD-operations read replica on a ("dp", "sp") mesh, refreshed
-    from a WAL or region-log tail."""
+def _keys_of(cells) -> np.ndarray:
+    return np.unique(
+        s2cell.cell_to_dar_key(np.asarray(cells, dtype=np.uint64))
+    ).astype(np.int32)
+
+
+class ShardedReplica:
+    """Multi-chip read replica of EVERY entity class on a ("dp", "sp")
+    mesh, refreshed from a WAL or region-log tail."""
 
     def __init__(
         self,
@@ -139,18 +160,23 @@ class ShardedOpReplica:
         self._tail = (
             _WalTail(wal_path) if wal_path else _RegionTail(region_client)
         )
-        self._records: Dict[str, Record] = {}
+        self._records: Dict[str, Dict[str, Record]] = {
+            c: {} for c in CLASSES
+        }
         self._owners: Dict[str, int] = {}
-        self._dirty = False
+        self._dirty = {c: False for c in CLASSES}
         self._mu = threading.Lock()  # guards records + tail + rebuild
         # serializes whole refresh() runs: publish order must match
         # build order (the warmup happens outside _mu, so without this
         # a slower older build could overwrite a newer snapshot)
         self._refresh_mu = threading.Lock()
-        self._snapshot: Optional[Tuple[ShardedDar, List[str]]] = None
+        self._snapshots: Dict[
+            str, Optional[Tuple[Optional[ShardedDar], List[str]]]
+        ] = {c: None for c in CLASSES}
         self._applied_records = 0
         self._apply_errors = 0
         self._rebuilds = 0
+        self._last_fresh = 0.0  # monotonic time of last caught-up sync
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -161,14 +187,11 @@ class ShardedOpReplica:
 
     def _rec_from_op_doc(self, doc: dict) -> Record:
         op = codec.doc_to_op(doc)
-        keys = np.unique(
-            s2cell.cell_to_dar_key(np.asarray(op.cells, dtype=np.uint64))
-        )
         from dss_tpu.clock import to_nanos
 
         return Record(
             entity_id=op.id,
-            keys=keys.astype(np.int32),
+            keys=_keys_of(op.cells),
             alt_lo=(
                 -np.inf if op.altitude_lower is None else float(op.altitude_lower)
             ),
@@ -180,25 +203,88 @@ class ShardedOpReplica:
             owner_id=self._intern(op.owner),
         )
 
+    def _rec_from_entity(self, ent) -> Record:
+        """ISA / RID sub / SCD sub share the cells + altitude_lo/hi +
+        start/end field shape."""
+        from dss_tpu.clock import to_nanos
+
+        return Record(
+            entity_id=ent.id,
+            keys=_keys_of(ent.cells),
+            alt_lo=(
+                -np.inf if ent.altitude_lo is None else float(ent.altitude_lo)
+            ),
+            alt_hi=(
+                np.inf if ent.altitude_hi is None else float(ent.altitude_hi)
+            ),
+            t_start=(
+                NO_TIME_LO if ent.start_time is None
+                else to_nanos(ent.start_time)
+            ),
+            t_end=(
+                NO_TIME_HI if ent.end_time is None
+                else to_nanos(ent.end_time)
+            ),
+            owner_id=self._intern(ent.owner),
+        )
+
+    def _put(self, cls: str, rec: Record) -> None:
+        self._records[cls][rec.entity_id] = rec
+        self._dirty[cls] = True
+
+    def _del(self, cls: str, eid: str) -> None:
+        if self._records[cls].pop(eid, None) is not None:
+            self._dirty[cls] = True
+
     def _apply_locked(self, rec: dict) -> None:
         t = rec.get("t", "")
         if t == "__replica_reset__":
             # build the replacement off to the side and swap only once
             # every doc parsed: a corrupt doc mid-snapshot must not
             # leave truncated state serving as complete
-            fresh = {}
-            for d in rec["state"].get("scd", {}).get("ops", []):
+            state = rec["state"]
+            fresh: Dict[str, Dict[str, Record]] = {c: {} for c in CLASSES}
+            for d in state.get("scd", {}).get("ops", []):
                 r = self._rec_from_op_doc(d)
-                fresh[r.entity_id] = r
+                fresh["ops"][r.entity_id] = r
+            for d in state.get("scd", {}).get("subs", []):
+                r = self._rec_from_entity(codec.doc_to_scd_sub(d))
+                fresh["scd_subs"][r.entity_id] = r
+            for d in state.get("rid", {}).get("isas", []):
+                r = self._rec_from_entity(codec.doc_to_isa(d))
+                fresh["isas"][r.entity_id] = r
+            for d in state.get("rid", {}).get("subs", []):
+                r = self._rec_from_entity(codec.doc_to_rid_sub(d))
+                fresh["rid_subs"][r.entity_id] = r
             self._records = fresh
-            self._dirty = True
+            for c in CLASSES:
+                self._dirty[c] = True
         elif t == "scd_op_put":
-            r = self._rec_from_op_doc(rec["doc"])
-            self._records[r.entity_id] = r
-            self._dirty = True
+            self._put("ops", self._rec_from_op_doc(rec["doc"]))
         elif t == "scd_op_del":
-            if self._records.pop(rec["id"], None) is not None:
-                self._dirty = True
+            self._del("ops", rec["id"])
+        elif t == "isa_put":
+            self._put(
+                "isas", self._rec_from_entity(codec.doc_to_isa(rec["doc"]))
+            )
+        elif t == "isa_del":
+            self._del("isas", rec["id"])
+        elif t == "rid_sub_put":
+            self._put(
+                "rid_subs",
+                self._rec_from_entity(codec.doc_to_rid_sub(rec["doc"])),
+            )
+        elif t == "rid_sub_del":
+            self._del("rid_subs", rec["id"])
+        elif t == "scd_sub_put":
+            self._put(
+                "scd_subs",
+                self._rec_from_entity(codec.doc_to_scd_sub(rec["doc"])),
+            )
+        elif t == "scd_sub_del":
+            self._del("scd_subs", rec["id"])
+        # rid_sub_bump / scd_sub_bump only touch notification indexes,
+        # which the spatial replica does not serve
         self._applied_records += 1
 
     def poll_once(self) -> int:
@@ -220,16 +306,25 @@ class ShardedOpReplica:
             return len(recs)
 
     def refresh(self) -> bool:
-        """Fold ingested records into a fresh ShardedDar and swap it in
-        (atomic for readers).  -> True if a new snapshot was published."""
+        """Fold ingested records into fresh ShardedDars (one per dirty
+        class) and swap them in (atomic per class for readers).
+        -> True if any new snapshot was published."""
         with self._refresh_mu:
-            return self._refresh_serialized()
+            published = False
+            for cls in CLASSES:
+                published |= self._refresh_class(cls)
+            if not self._has_tail_errors():
+                self._last_fresh = time.monotonic()
+            return published
 
-    def _refresh_serialized(self) -> bool:
+    def _has_tail_errors(self) -> bool:
+        return bool(getattr(self._tail, "errors", 0))
+
+    def _refresh_class(self, cls: str) -> bool:
         with self._mu:
-            if not self._dirty and self._snapshot is not None:
+            if not self._dirty[cls] and self._snapshots[cls] is not None:
                 return False
-            recs = list(self._records.values())
+            recs = list(self._records[cls].values())
             ids = [r.entity_id for r in recs]
             dar = (
                 ShardedDar(recs, self.mesh, max_results=self.max_results)
@@ -238,7 +333,7 @@ class ShardedOpReplica:
             )
             # records ingested while we build/warm re-mark dirty and
             # are picked up by the next refresh
-            self._dirty = False
+            self._dirty[cls] = False
         # warm the new snapshot's query executable BEFORE publishing:
         # the jit cache keys on the snapshot's postings-run capacity,
         # so a rebuild can mean a fresh XLA compile — readers keep
@@ -256,7 +351,7 @@ class ShardedOpReplica:
             except Exception:  # noqa: BLE001 — warmup is best-effort
                 pass
         with self._mu:
-            self._snapshot = (dar, ids)
+            self._snapshots[cls] = (dar, ids)
             self._rebuilds += 1
         return True
 
@@ -268,6 +363,8 @@ class ShardedOpReplica:
     # -- background tailing ---------------------------------------------------
 
     def start(self, interval_s: float = 0.5) -> None:
+        self._interval_s = interval_s
+
         def loop():
             while not self._stop.wait(interval_s):
                 try:
@@ -287,6 +384,20 @@ class ShardedOpReplica:
 
     # -- serving reads --------------------------------------------------------
 
+    def staleness_s(self) -> float:
+        """Seconds since the replica last finished a caught-up sync."""
+        if self._last_fresh == 0.0:
+            return float("inf")
+        return time.monotonic() - self._last_fresh
+
+    def fresh(self, bound_s: Optional[float] = None) -> bool:
+        """True when the replica synced within `bound_s` (default: 4x
+        the refresh interval) — the offload gate for bounded-staleness
+        reads."""
+        if bound_s is None:
+            bound_s = 4 * getattr(self, "_interval_s", 0.5)
+        return self.staleness_s() <= bound_s
+
     def query(
         self,
         keys: np.ndarray,  # int32 DAR keys
@@ -296,38 +407,91 @@ class ShardedOpReplica:
         t_end: Optional[int] = None,
         *,
         now: int,
+        cls: str = "ops",
     ) -> List[str]:
-        """Operation ids intersecting the query volume, from the
-        current snapshot (one atomic snapshot grab per query)."""
-        snap = self._snapshot
-        if snap is None or snap[0] is None:
-            return []
-        dar, ids = snap
+        """Entity ids intersecting the query volume, from the current
+        snapshot of `cls` (one atomic snapshot grab per query)."""
         keys = np.asarray(keys, np.int32).ravel()
         if keys.size == 0:
             return []
-        out = dar.query_batch(
-            keys[None, :],
-            np.asarray(
-                [-np.inf if alt_lo is None else alt_lo], np.float32
-            ),
+        rows = self.query_batch(
+            [keys],
+            np.asarray([-np.inf if alt_lo is None else alt_lo], np.float32),
             np.asarray([np.inf if alt_hi is None else alt_hi], np.float32),
             np.asarray(
                 [NO_TIME_LO if t_start is None else t_start], np.int64
             ),
             np.asarray([NO_TIME_HI if t_end is None else t_end], np.int64),
             now=now,
-        )[0]
-        return sorted(ids[s] for s in out if s < len(ids))
+            cls=cls,
+        )
+        return rows[0]
+
+    def query_batch(
+        self,
+        keys_list,  # sequence of int32 DAR-key arrays
+        alt_lo: np.ndarray,
+        alt_hi: np.ndarray,
+        t_start: np.ndarray,
+        t_end: np.ndarray,
+        *,
+        now,  # scalar or i64[B]
+        cls: str = "ops",
+    ) -> List[List[str]]:
+        """Batched mesh query -> entity-id lists (sorted)."""
+        snap = self._snapshots[cls]
+        b = len(keys_list)
+        if snap is None or snap[0] is None:
+            return [[] for _ in range(b)]
+        dar, ids = snap
+        from dss_tpu.dar.pack import pow2_at_least
+
+        width = pow2_at_least(
+            max((len(k) for k in keys_list), default=1), lo=16
+        )
+        qkeys = np.full((b, width), -1, np.int32)
+        for i, k in enumerate(keys_list):
+            u = np.unique(np.asarray(k, np.int32))
+            qkeys[i, : len(u)] = u
+        rows = dar.query_batch(
+            qkeys,
+            np.asarray(alt_lo, np.float32),
+            np.asarray(alt_hi, np.float32),
+            np.asarray(t_start, np.int64),
+            np.asarray(t_end, np.int64),
+            now=now,
+        )
+        return [
+            sorted(ids[s] for s in row if s < len(ids)) for row in rows
+        ]
 
     def stats(self) -> dict:
-        snap = self._snapshot
-        return {
-            "replica_records": len(self._records),
-            "replica_snapshot_records": 0 if snap is None else len(snap[1]),
+        out = {
             "replica_applied_records": self._applied_records,
             "replica_apply_errors": self._apply_errors,
             "replica_tail_errors": getattr(self._tail, "errors", 0),
             "replica_rebuilds": self._rebuilds,
-            "replica_dirty": int(self._dirty),
+            "replica_staleness_s": (
+                -1.0
+                if self._last_fresh == 0.0
+                else round(self.staleness_s(), 3)
+            ),
         }
+        for cls in CLASSES:
+            snap = self._snapshots[cls]
+            out[f"replica_{cls}_records"] = len(self._records[cls])
+            out[f"replica_{cls}_snapshot_records"] = (
+                0 if snap is None else len(snap[1])
+            )
+            out[f"replica_{cls}_overflow_fallbacks"] = (
+                0
+                if snap is None or snap[0] is None
+                else snap[0].overflow_fallbacks
+            )
+            out[f"replica_{cls}_dirty"] = int(self._dirty[cls])
+        return out
+
+
+class ShardedOpReplica(ShardedReplica):
+    """Back-compat alias: the r3/r4 SCD-operations-only replica surface
+    (query defaults to cls='ops')."""

@@ -79,7 +79,7 @@ def _local_query(
     post: Postings,
     ents: EntityTable,
     q: QuerySpec,
-    now,
+    now,  # [Q] int64 per-query visibility time
     owner,
     *,
     cap: int,
@@ -89,16 +89,16 @@ def _local_query(
     """Per-device: candidates from the local postings range, 4D test,
     compact to shard_results.  Returns (slots [Q, sr], n_unique [Q])."""
 
-    def one(qq, ow):
+    def one(qq, nw, ow):
         ent, valid = _candidates(post, ents, qq.keys, cap)
         hit = valid & _attr_test(
-            ents, ent, qq, now, ow if with_owner else None
+            ents, ent, qq, nw, ow if with_owner else None
         )
         return _compact_unique(ent, hit, shard_results)
 
     if with_owner:
-        return jax.vmap(one)(q, owner)
-    return jax.vmap(one, in_axes=(0, None))(q, jnp.int32(0))
+        return jax.vmap(one)(q, now, owner)
+    return jax.vmap(one, in_axes=(0, 0, None))(q, now, jnp.int32(0))
 
 
 @partial(
@@ -116,7 +116,7 @@ def sharded_conflict_query_batch(
     post_ent,  # [n_sp, Ps] int32
     ents: EntityTable,  # replicated
     q: QuerySpec,  # leading batch axis Q, Q % dp == 0
-    now,
+    now,  # [Q] int64 per-query visibility time
     owner=None,  # [Q] int32 when with_owner
     *,
     mesh: Mesh,
@@ -168,7 +168,7 @@ def sharded_conflict_query_batch(
             qspec,
             qspec,
             qspec,  # q scalars-per-query
-            P(),  # now
+            qspec,  # now (per-query)
             qspec,  # owner
         ),
         out_specs=(P("dp", None), P("dp")),
@@ -211,6 +211,7 @@ class ShardedDar:
         self.max_results = max_results
         self.shard_results = shard_results or max_results
         self.records = {slot: r for slot, r in enumerate(records)}
+        self.overflow_fallbacks = 0  # host-scan fallbacks (observability)
 
         packed = pack_records(records, pad_postings=False)
         self.cap = packed.base_cap
@@ -239,10 +240,13 @@ class ShardedDar:
         t_start: np.ndarray,  # [Q] i64
         t_end: np.ndarray,
         *,
-        now: int,
+        now,  # int scalar or [Q] i64 per-query visibility time
     ):
         """Run a batch of queries; returns list-of-lists of entity slots."""
         qn = keys_batch.shape[0]
+        now_arr = np.broadcast_to(
+            np.asarray(now, np.int64), (qn,)
+        ).copy()
         # pad the key width to a pow2 bucket: K is data-dependent (area
         # covering size) and an unpadded shape would compile a fresh
         # executable per distinct K
@@ -268,6 +272,9 @@ class ShardedDar:
             alt_hi = np.concatenate([alt_hi, np.full(pad, np.inf, np.float32)])
             t_start = np.concatenate([t_start, np.full(pad, NO_TIME_LO)])
             t_end = np.concatenate([t_end, np.full(pad, NO_TIME_HI)])
+            now_arr = np.concatenate(
+                [now_arr, np.zeros(pad, np.int64)]
+            )
         spec = QuerySpec(
             keys=jnp.asarray(keys_batch, jnp.int32),
             alt_lo=jnp.asarray(alt_lo, jnp.float32),
@@ -280,7 +287,7 @@ class ShardedDar:
             self.post_ent,
             self.ents,
             spec,
-            jnp.int64(now),
+            jnp.asarray(now_arr, jnp.int64),
             mesh=self.mesh,
             cap=self.cap,
             shard_results=self.shard_results,
@@ -291,6 +298,10 @@ class ShardedDar:
         out = []
         for i in range(qn):
             if ovf[i]:
+                # result wider than max_results: exact host fallback
+                # for this query (counted — a hot cell silently
+                # degrading to the slow path must be observable)
+                self.overflow_fallbacks += 1
                 out.append(
                     oracle.search(
                         self.records,
@@ -301,7 +312,7 @@ class ShardedDar:
                         None if alt_hi[i] == np.inf else float(alt_hi[i]),
                         None if t_start[i] == NO_TIME_LO else int(t_start[i]),
                         None if t_end[i] == NO_TIME_HI else int(t_end[i]),
-                        now,
+                        int(now_arr[i]),
                     )
                 )
             else:

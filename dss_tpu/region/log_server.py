@@ -67,6 +67,8 @@ class RegionLog:
         self._snap_state: Optional[dict] = None
         for rec in self._wal.replay():
             t = rec.get("t")
+            if t == "__format__":
+                continue  # version gate runs inside replay()
             if t == "__snapshot__":
                 self._snap_index = int(rec["index"])
                 self._snap_state = rec["state"]
@@ -189,7 +191,10 @@ class RegionLog:
         seq = 0
         fh = open(tmp, "w", encoding="utf-8")
         try:
-            for rec in plan["head_records"]:
+            from dss_tpu.dar import wal as _walmod
+
+            # the rewrite carries the format version forward
+            for rec in [_walmod.format_record()] + plan["head_records"]:
                 seq += 1
                 fh.write(
                     json.dumps(dict(rec, seq=seq), separators=(",", ":"))

@@ -165,7 +165,11 @@ class RIDService:
         if earliest is None or earliest < now:
             earliest = now
         with stages.stage("store_ms"):
-            isas = self.store.search_isas(cells, earliest, latest)
+            # allow_stale: a public search may ride the mesh replica
+            # when its batch is oversized and the replica is fresh
+            isas = self.store.search_isas(
+                cells, earliest, latest, allow_stale=True
+            )
         with stages.stage("serialize_ms"):
             return {"service_areas": [ser.isa_to_json(i) for i in isas]}
 

@@ -195,8 +195,12 @@ class SCDService:
         ) as e:
             raise _area_error(e)
         sv = vol4.spatial_volume
+        # allow_stale: public search may ride the mesh replica for
+        # oversized batches (the conflict-response listing at :117 must
+        # NOT — it feeds the OVN key the client will retry with)
         ops = self.store.search_operations(
-            cells, sv.altitude_lo, sv.altitude_hi, vol4.start_time, vol4.end_time
+            cells, sv.altitude_lo, sv.altitude_hi, vol4.start_time,
+            vol4.end_time, allow_stale=True,
         )
         out = []
         for op in ops:

@@ -430,7 +430,37 @@ def test_sharded_replica_surface(certs, oauth, tmp_path_factory):
                 break
             assert time.monotonic() < deadline, body
             time.sleep(0.3)
-        assert body["replica"]["replica_snapshot_records"] >= 1
+        assert body["replica"]["replica_ops_snapshot_records"] >= 1
+
+        # RID ISAs are mesh-served too (every entity class replicates)
+        isa1 = str(uuid.uuid4())
+        r = requests.put(
+            f"{base}/v1/dss/identification_service_areas/{isa1}",
+            json=isa_params(lat=lat),
+            headers=oauth.hdr(RID_SCOPE, sub="uss1"),
+            timeout=5,
+        )
+        assert r.status_code == 200, r.text
+        deadline = time.monotonic() + 120
+        while True:
+            r = requests.get(
+                f"{base}/aux/v1/replica/identification_service_areas",
+                params={"area": area},
+                headers=oauth.hdr(RID_SCOPE, sub="uss1"),
+                timeout=90,
+            )
+            if r.status_code == 504:
+                assert time.monotonic() < deadline, "compile never finished"
+                time.sleep(0.3)
+                continue
+            assert r.status_code == 200, r.text
+            body = r.json()
+            if isa1 in body["service_area_ids"]:
+                break
+            assert time.monotonic() < deadline, body
+            time.sleep(0.3)
+        assert body["replica"]["replica_isas_snapshot_records"] >= 1
+
         # auth enforced on the replica surface too
         assert (
             requests.get(

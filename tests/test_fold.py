@@ -1,0 +1,152 @@
+"""DarTable off-lock folding: overlay overflow, idle compaction,
+mid-fold writes/removals, and the O(Δ) overlay splice."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dss_tpu.dar.oracle import Record
+from dss_tpu.dar.snapshot import DarTable, _overlay_upsert, _pack_overlay
+
+
+def _rec(i, keys, owner=0, t0=0, t1=10**18):
+    return Record(
+        entity_id=f"e{i}",
+        keys=np.asarray(keys, np.int32),
+        alt_lo=-np.inf,
+        alt_hi=np.inf,
+        t_start=t0,
+        t_end=t1,
+        owner_id=owner,
+    )
+
+
+def _put(t, i, keys):
+    t.upsert(f"e{i}", np.asarray(keys, np.int32), None, None, 0, 10**18, 0)
+
+
+def _q(t, keys):
+    return t.query(np.asarray(keys, np.int32), now=1)
+
+
+def test_overlay_splice_matches_full_pack():
+    """_overlay_upsert (incremental) must produce the same postings as
+    a from-scratch _pack_overlay, modulo local index assignment."""
+    rng = np.random.default_rng(3)
+    pending = {}
+    ov = None
+    idx_of = {}
+    for step in range(200):
+        i = int(rng.integers(0, 50))
+        keys = np.unique(rng.integers(0, 100, rng.integers(1, 6)))
+        r = _rec(i, keys)
+        pending[r.entity_id] = r
+        ov, idx = _overlay_upsert(ov, r, idx_of.get(r.entity_id))
+        idx_of[r.entity_id] = idx
+        assert np.all(np.diff(ov.key) >= 0)  # stays sorted
+    ref = _pack_overlay(pending)
+    # same (key -> entity_id) posting multiset
+    got = sorted((int(k), ov.ids[e]) for k, e in zip(ov.key, ov.ent))
+    want = sorted((int(k), ref.ids[e]) for k, e in zip(ref.key, ref.ent))
+    assert got == want
+
+
+def test_overflow_triggers_background_fold():
+    t = DarTable(delta_capacity=64, idle_fold_s=0.05)
+    for i in range(100):
+        _put(t, i, [i, i + 1])
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        s = t.stats()
+        if s["folds"] >= 1 and s["pending_records"] == 0:
+            break
+        time.sleep(0.02)
+    s = t.stats()
+    assert s["folds"] >= 1
+    assert s["snapshot_records"] == 100
+    assert _q(t, [50]) == ["e49", "e50"]
+
+
+def test_idle_fold_compacts_small_overlay():
+    t = DarTable(delta_capacity=10_000, idle_fold_s=0.05)
+    for i in range(10):
+        _put(t, i, [i])
+    # trigger the folder thread (normally started by overflow)
+    t._request_fold()
+    t._fold_event.clear()
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if t.stats()["pending_records"] == 0 and t.stats()["folds"] >= 1:
+            break
+        time.sleep(0.02)
+    assert t.stats()["pending_records"] == 0
+    assert _q(t, [3]) == ["e3"]
+
+
+def test_writes_and_removes_during_fold_are_kept():
+    """Records written/removed while a fold is building must be exactly
+    reflected after the swap."""
+    t = DarTable(delta_capacity=1 << 30, idle_fold_s=0)
+    for i in range(300):
+        _put(t, i, [i % 40])
+    stop = threading.Event()
+    wrote = []
+
+    def writer():
+        j = 1000
+        while not stop.is_set():
+            _put(t, j, [j % 40])
+            wrote.append(j)
+            if j % 3 == 0:
+                t.remove(f"e{j}")
+                wrote.pop()
+            j += 1
+
+    th = threading.Thread(target=writer)
+    th.start()
+    try:
+        for _ in range(5):
+            t.fold()
+    finally:
+        stop.set()
+        th.join()
+    t.fold()
+    # every surviving mid-fold write visible; removed ones are not
+    for j in wrote[-50:]:
+        assert f"e{j}" in _q(t, [j % 40]), j
+    # removals stuck
+    assert "e1002" not in _q(t, [1002 % 40])
+    # original records intact
+    assert "e7" in _q(t, [7 % 40])
+
+
+def test_update_and_remove_in_overlay():
+    t = DarTable(delta_capacity=10_000, idle_fold_s=0)
+    _put(t, 1, [5, 6])
+    _put(t, 2, [6, 7])
+    assert _q(t, [6]) == ["e1", "e2"]
+    _put(t, 1, [9])  # move e1: must vanish from 5/6, appear at 9
+    assert _q(t, [6]) == ["e2"]
+    assert _q(t, [5]) == []
+    assert _q(t, [9]) == ["e1"]
+    t.remove("e2")
+    assert _q(t, [6]) == []
+    assert _q(t, [7]) == []
+
+
+def test_fold_then_update_then_query():
+    t = DarTable(delta_capacity=10_000, idle_fold_s=0)
+    for i in range(20):
+        _put(t, i, [i])
+    t.fold()
+    assert t.stats()["pending_records"] == 0
+    _put(t, 3, [77])  # update a folded record -> dead slot + overlay
+    assert _q(t, [3]) == []
+    assert _q(t, [77]) == ["e3"]
+    t.fold()
+    assert _q(t, [77]) == ["e3"]
+    assert _q(t, [3]) == []

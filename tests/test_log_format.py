@@ -1,0 +1,98 @@
+"""Log format version gate (the reference's MustSupportSchema analog,
+cmds/grpc-backend/main.go:75-86): booting against a log written by an
+incompatible future format refuses cleanly instead of replaying
+garbage; compaction carries the version record forward."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from dss_tpu.dar.wal import (
+    FORMAT_VERSION,
+    LogFormatError,
+    WriteAheadLog,
+    format_record,
+)
+
+
+def test_fresh_wal_gets_format_header(tmp_path):
+    p = tmp_path / "wal.jsonl"
+    w = WriteAheadLog(str(p))
+    w.append({"t": "isa_put", "doc": {}})
+    w.close()
+    lines = [json.loads(x) for x in p.read_text().splitlines()]
+    assert lines[0]["t"] == "__format__"
+    assert lines[0]["version"] == FORMAT_VERSION
+    # reopen: no second header, seq continues
+    w2 = WriteAheadLog(str(p))
+    s = w2.append({"t": "isa_del", "id": "x"})
+    w2.close()
+    lines = [json.loads(x) for x in p.read_text().splitlines()]
+    assert sum(1 for l in lines if l["t"] == "__format__") == 1
+    assert s == len(lines) - 1  # header carries no seq
+
+
+def test_future_version_refuses_boot(tmp_path):
+    p = tmp_path / "wal.jsonl"
+    p.write_text(
+        json.dumps({"t": "__format__", "version": FORMAT_VERSION + 1,
+                    "seq": 1}) + "\n"
+        + json.dumps({"t": "isa_put", "doc": {}, "seq": 2}) + "\n"
+    )
+    with pytest.raises(LogFormatError, match="refusing to start"):
+        WriteAheadLog(str(p))
+
+
+def test_future_version_refuses_store_boot(tmp_path):
+    from dss_tpu.dar.dss_store import DSSStore
+
+    p = tmp_path / "wal.jsonl"
+    p.write_text(
+        json.dumps({"t": "__format__", "version": 99, "seq": 1}) + "\n"
+    )
+    with pytest.raises(LogFormatError):
+        DSSStore(storage="memory", wal_path=str(p))
+
+
+def test_legacy_headerless_log_accepted(tmp_path):
+    p = tmp_path / "wal.jsonl"
+    p.write_text(json.dumps({"t": "unknown_future_type", "seq": 1}) + "\n")
+    w = WriteAheadLog(str(p))
+    assert w.seq == 1
+    w.close()
+
+
+def test_follower_tail_gates_format(tmp_path):
+    from dss_tpu.parallel.replica import _WalTail
+
+    p = tmp_path / "wal.jsonl"
+    p.write_text(
+        json.dumps({"t": "__format__", "version": 99, "seq": 1}) + "\n"
+    )
+    with pytest.raises(LogFormatError):
+        _WalTail(str(p)).poll()
+
+
+def test_region_log_compaction_carries_format(tmp_path):
+    from dss_tpu.region.log_server import RegionLog
+
+    p = tmp_path / "region.wal"
+    log = RegionLog(str(p))
+    tok = log.acquire("a", 30.0)
+    assert tok is not None
+    for k in range(4):
+        assert log.append(tok, [{"t": "isa_put", "doc": {"id": str(k)}}]) is not None
+    plan = log.put_snapshot(3, {"rid": {}, "scd": {}})
+    staging = log.begin_compact(plan)
+    log.finish_compact(staging)
+    log.close()
+    lines = [json.loads(x) for x in p.read_text().splitlines()]
+    assert lines[0]["t"] == "__format__"
+    assert lines[0]["version"] == FORMAT_VERSION
+    # and the compacted log reboots cleanly with state intact
+    log2 = RegionLog(str(p))
+    assert log2.head == 4
+    assert log2.snapshot_index == 3
+    log2.close()

@@ -255,6 +255,156 @@ def test_replica_tails_live_wal_into_sharded_dar(tmp_path):
 
     st = rep.stats()
     assert st["replica_rebuilds"] >= 3
-    assert st["replica_snapshot_records"] == len(ids1) - 1 + len(ids2)
+    assert st["replica_ops_snapshot_records"] == len(ids1) - 1 + len(ids2)
     rep.close()
     store.close()
+
+
+def test_replica_serves_every_entity_class(tmp_path):
+    """ISAs, RID subs, and SCD subs replicate to the mesh alongside
+    ops (the reference's range sharding covers every table,
+    implementation_details.md:11-42)."""
+    import time as _t
+    import uuid
+
+    from dss_tpu.dar.dss_store import DSSStore
+    from dss_tpu.geo import covering as geo_covering
+    from dss_tpu.geo import s2cell
+    from dss_tpu.parallel.replica import ShardedReplica
+    from dss_tpu.services.rid import RIDService
+    from dss_tpu.services.scd import SCDService
+
+    wal = tmp_path / "dss.wal"
+    store = DSSStore(storage="memory", wal_path=str(wal))
+    rid = RIDService(store.rid, store.clock)
+    scd = SCDService(store.scd, store.clock)
+
+    mesh = make_mesh(8, dp=2, sp=4)
+    rep = ShardedReplica(mesh, wal_path=str(wal))
+
+    def iso(off):
+        return _t.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", _t.gmtime(_t.time() + off)
+        )
+
+    isa_id = str(uuid.uuid4())
+    rid.create_isa(
+        isa_id,
+        {
+            "extents": {
+                "spatial_volume": {
+                    "footprint": {
+                        "vertices": [
+                            {"lat": 40.0, "lng": -100.0},
+                            {"lat": 40.02, "lng": -100.0},
+                            {"lat": 40.02, "lng": -99.98},
+                            {"lat": 40.0, "lng": -99.98},
+                        ]
+                    },
+                    "altitude_lo": 10.0,
+                    "altitude_hi": 300.0,
+                },
+                "time_start": iso(60),
+                "time_end": iso(3600),
+            },
+            "flights_url": "https://u1.example.com/f",
+        },
+        "uss1",
+    )
+    sub_id = str(uuid.uuid4())
+    rid.create_subscription(
+        sub_id,
+        {
+            "extents": {
+                "spatial_volume": {
+                    "footprint": {
+                        "vertices": [
+                            {"lat": 40.0, "lng": -100.0},
+                            {"lat": 40.02, "lng": -100.0},
+                            {"lat": 40.02, "lng": -99.98},
+                            {"lat": 40.0, "lng": -99.98},
+                        ]
+                    },
+                    "altitude_lo": 0.0,
+                    "altitude_hi": 3000.0,
+                },
+                "time_start": iso(60),
+                "time_end": iso(3600),
+            },
+            "callbacks": {
+                "identification_service_area_url": "https://u1.example.com"
+            },
+        },
+        "uss1",
+    )
+    op_id = str(uuid.uuid4())
+    scd.put_operation(op_id, _op_params_at(40.0), "uss1")
+    rep.sync()
+
+    cells = geo_covering.covering_polygon(
+        [(40.0, -100.0), (40.02, -100.0), (40.02, -99.98), (40.0, -99.98)]
+    )
+    keys = s2cell.cell_to_dar_key(cells)
+    now = int(_t.time() * 1e9) + int(120e9)
+    assert rep.query(keys, now=now, cls="isas") == [isa_id]
+    assert rep.query(keys, now=now, cls="rid_subs") == [sub_id]
+    assert op_id in rep.query(keys, now=now, cls="ops")
+    # the put_operation creates an implicit SCD subscription
+    assert len(rep.query(keys, now=now, cls="scd_subs")) == 1
+    st = rep.stats()
+    assert st["replica_isas_snapshot_records"] == 1
+    assert st["replica_rid_subs_snapshot_records"] == 1
+    assert st["replica_scd_subs_snapshot_records"] == 1
+    # deletes propagate per class
+    v = rid.get_isa(isa_id)["service_area"]["version"]
+    rid.delete_isa(isa_id, v, "uss1")
+    rep.sync()
+    assert rep.query(keys, now=now, cls="isas") == []
+    rep.close()
+    store.close()
+
+
+def test_mesh_offload_for_oversized_stale_ok_batches(tmp_path):
+    """Batches of >= min_batch allow_stale queries route to the mesh
+    delegate when fresh; conflict prechecks (allow_stale=False) and
+    owner-filtered queries never do."""
+    from dss_tpu.dar.coalesce import QueryCoalescer, _Item
+    from dss_tpu.dar.snapshot import DarTable
+
+    table = DarTable()
+    table.upsert("local", np.asarray([5], np.int32), None, None, 0,
+                 10**18, 0)
+    co = QueryCoalescer(table)
+    calls = []
+
+    def mesh_fn(keys_list, alo, ahi, ts, te, now_arr):
+        calls.append(len(keys_list))
+        return [["mesh-answer"] for _ in keys_list]
+
+    co.set_mesh_delegate(mesh_fn, lambda: True, min_batch=2)
+
+    def item(allow_stale, owner=None):
+        return _Item(
+            np.asarray([5], np.int32), None, None, None, None, 1,
+            owner, allow_stale,
+        )
+
+    # all stale-ok, no owner filter -> offloaded
+    b = [item(True), item(True)]
+    co._execute(b)
+    assert [it.result for it in b] == [["mesh-answer"], ["mesh-answer"]]
+    assert co.mesh_offloads == 1
+    # one conflict-precheck item (allow_stale=False) -> local
+    b = [item(True), item(False)]
+    co._execute(b)
+    assert [it.result for it in b] == [["local"], ["local"]]
+    # owner-filtered -> local
+    b = [item(True, owner=0), item(True, owner=0)]
+    co._execute(b)
+    assert [it.result for it in b] == [["local"], ["local"]]
+    # below min_batch -> local
+    b = [item(True)]
+    co._execute(b)
+    assert b[0].result == ["local"]
+    assert co.mesh_offloads == 1
+    co.close()
