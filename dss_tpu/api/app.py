@@ -388,6 +388,7 @@ def build_app(
         from dss_tpu.obs import stages as _stages
 
         sink = request.get("dss_stages")
+        before = None if sink is None else dict(sink)
         t0 = time.perf_counter()
         if sink is not None:
             _stages.set_sink(sink)
@@ -395,6 +396,11 @@ def build_app(
         try:
             return fn(*args)
         except _budget.NeedsDevice:
+            if sink is not None:
+                # drop the aborted inline attempt's partial stage
+                # timings — the executor re-run records the real ones
+                sink.clear()
+                sink.update(before)
             return await _call(fn, *args, request=request)
         finally:
             _budget.set_host_only(False)
@@ -519,23 +525,27 @@ def build_app(
         def _now_ns_fn():
             return int(_time.time() * 1e9)
 
-        # URL segment -> (replica class, auth operation, response key)
+        # URL segment -> (replica class, auth operation, response key,
+        # owner-scoped).  Subscription ids are owner-private: those
+        # surfaces filter to the authenticated owner's entities, same
+        # as the store search paths.
         replica_surfaces = {
             "operations": (
-                "ops", _AUX + "ReplicaSearchOperations", "operation_ids"
+                "ops", _AUX + "ReplicaSearchOperations",
+                "operation_ids", False,
             ),
             "identification_service_areas": (
                 "isas",
                 _RID + "SearchIdentificationServiceAreas",
-                "service_area_ids",
+                "service_area_ids", False,
             ),
             "subscriptions": (
                 "rid_subs", _RID + "SearchSubscriptions",
-                "subscription_ids",
+                "subscription_ids", True,
             ),
             "scd_subscriptions": (
                 "scd_subs", _SCD + "QuerySubscriptions",
-                "subscription_ids",
+                "subscription_ids", True,
             ),
         }
 
@@ -546,8 +556,8 @@ def build_app(
                     "unknown replica surface; one of: "
                     + ", ".join(sorted(replica_surfaces))
                 )
-            cls, operation, out_key = surface
-            auth(request, operation)
+            cls, operation, out_key, owner_scoped = surface
+            owner = auth(request, operation)
             area = request.query.get("area", "")
             try:
                 cells = geo_covering.area_to_cell_ids(area)
@@ -587,6 +597,7 @@ def build_app(
                     parse_t("latest_time"),
                     now=_now_ns_fn(),
                     cls=cls,
+                    owner=owner if owner_scoped else None,
                 )
             )
             return web.json_response(

@@ -148,3 +148,29 @@ def missing_ovns(conflicting_ops) -> StatusError:
         "at least one current operation is missing from the key",
         details=conflicting_ops,
     )
+
+
+def retry_write_conflicts(fn):
+    """Service-method decorator: re-run the whole (rolled-back)
+    operation when a region optimistic append lost a disjointness race
+    — the internal-retry contract the reference gets from its CRDB txn
+    retrier (pkg/rid/cockroach/store.go:19-26).  The retry rides the
+    lease path (the coordinator cools down to lease-only after a
+    conflict), so it serializes instead of racing again."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        attempts = 3
+        for attempt in range(attempts):
+            try:
+                return fn(*args, **kwargs)
+            except StatusError as e:
+                if (
+                    not getattr(e, "retryable_write_conflict", False)
+                    or attempt == attempts - 1
+                ):
+                    raise
+        raise AssertionError("unreachable")
+
+    return wrapper

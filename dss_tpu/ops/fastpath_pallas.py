@@ -89,3 +89,128 @@ def filter_windows_pallas(
         out_shape=[jax.ShapeDtypeStruct((nw, BLOCK), jnp.int8)],
         interpret=interpret,
     )(win_blk, qk, qalo_mm, qahi_mm, qt0s, qt1s, p3)[0]
+
+
+# ---------------------------------------------------------------------------
+# Fused-path twin: exact columns + lane-range windows + on-device bit-pack
+# ---------------------------------------------------------------------------
+#
+# Mirrors FastTable._fused_xla's filter+pack stages (fastpath.py:368-415)
+# with explicit DMA scheduling: per window, the EXACT f32 altitude and
+# i64 time block columns stream HBM->VMEM double-buffered, the 4D
+# compare runs on lanes [start, end), and the 128 hit lanes bit-pack to
+# 4 u32 words on device.  The compaction stage (cumsum+scatter of
+# non-empty words) remains XLA — that is the documented lowering delta
+# (docs/DESIGN.md): compaction is a data-dependent scatter that XLA
+# already schedules well, while filter+pack dominate the FLOPs/bytes.
+#
+# Output lane layout: (NW, 128) i32 with words in lanes 0..3 and zeros
+# elsewhere — full-width blocks so the kernel stays tile-aligned for
+# the day the Mosaic toolchain in this environment can compile it
+# (interpret=True everywhere until then; differential parity is pinned
+# by tests/test_pallas_fused_parity.py).
+
+
+def _fused_kernel(blk_ref, meta_ref, alo_ref, ahi_ref, t0_ref, t1_ref,
+                  alt_hbm, time_hbm, words_ref, alt_scr, time_scr, sems):
+    g = pl.program_id(0)
+    base = g * GROUP
+
+    def dma_alt(i, slot):
+        return pltpu.make_async_copy(
+            alt_hbm.at[pl.ds(blk_ref[base + i], 1)],
+            alt_scr.at[slot],
+            sems.at[slot, 0],
+        )
+
+    def dma_time(i, slot):
+        return pltpu.make_async_copy(
+            time_hbm.at[pl.ds(blk_ref[base + i], 1)],
+            time_scr.at[slot],
+            sems.at[slot, 1],
+        )
+
+    dma_alt(jnp.int32(0), 0).start()
+    dma_time(jnp.int32(0), 0).start()
+    for i in range(GROUP):
+        slot = i % 2
+        if i + 1 < GROUP:
+            dma_alt(jnp.int32(i + 1), (i + 1) % 2).start()
+            dma_time(jnp.int32(i + 1), (i + 1) % 2).start()
+        dma_alt(jnp.int32(i), slot).wait()
+        dma_time(jnp.int32(i), slot).wait()
+        alt = alt_scr[slot]    # (1, 2, 128) f32: [alo, ahi]
+        tim = time_scr[slot]   # (1, 2, 128) i64: [t0, t1]
+        w = base + i
+        meta = meta_ref[w]
+        start = meta & 0xFF
+        end = (meta >> 8) & 0xFF
+        lanes = jax.lax.broadcasted_iota(jnp.int32, (1, BLOCK), 1)
+        hit = (
+            (lanes >= start)
+            & (lanes < end)
+            & (alt[:, 1, :] >= qf32_ref_get(alo_ref, w))
+            & (alt[:, 0, :] <= qf32_ref_get(ahi_ref, w))
+            & (tim[:, 1, :] >= t0_ref[w])
+            & (tim[:, 0, :] <= t1_ref[w])
+        )  # (1, 128) bool, exact
+        # bit-pack 128 lanes -> 4 i32 words in lanes 0..3 (disjoint
+        # bits: modular add == bitwise OR, matching _fused_xla)
+        h = hit.astype(jnp.int32).reshape(1, 4, 32)
+        words = jnp.sum(
+            h << jax.lax.broadcasted_iota(jnp.int32, (1, 4, 32), 2),
+            axis=2,
+            dtype=jnp.int32,
+        )  # (1, 4)
+        row = jnp.zeros((1, BLOCK), jnp.int32)
+        words_ref[i : i + 1, :] = row.at[:, :4].set(words)
+
+
+def qf32_ref_get(ref, i):
+    """Scalar-prefetch refs hold f32 per-window query bounds; indexing
+    helper kept explicit for Mosaic-compat experiments."""
+    return ref[i]
+
+
+@partial(jax.jit, static_argnames=("interpret",))
+def fused_filter_pack_pallas(
+    b_alo,  # (NB, 128) f32 exact block columns
+    b_ahi,
+    b_t0,  # (NB, 128) i64
+    b_t1,
+    win_blk,  # (NW,) i32 block index per window, NW % GROUP == 0
+    meta,  # (NW,) i32: start | end<<8 (lane range within the block)
+    alo_w,  # (NW,) f32 per-window query bounds (pre-gathered by qidx)
+    ahi_w,
+    t0_w,  # (NW,) i64 (t_start pre-folded with now, as _fused_xla)
+    t1_w,
+    *,
+    interpret: bool = False,
+):
+    """-> (NW, 4) i32 hit-bit words, identical to _fused_xla's
+    pre-compaction words."""
+    nw = win_blk.shape[0]
+    assert nw % GROUP == 0, f"NW must be padded to a multiple of {GROUP}"
+    alt = jnp.stack([b_alo, b_ahi], axis=1)  # (NB, 2, 128) f32
+    tim = jnp.stack([b_t0, b_t1], axis=1)  # (NB, 2, 128) i64
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=6,
+        grid=(nw // GROUP,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=[pl.BlockSpec((GROUP, BLOCK), lambda g, *_: (g, 0))],
+        scratch_shapes=[
+            pltpu.VMEM((2, 1, 2, BLOCK), jnp.float32),
+            pltpu.VMEM((2, 1, 2, BLOCK), jnp.int64),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+    )
+    out = pl.pallas_call(
+        _fused_kernel,
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct((nw, BLOCK), jnp.int32)],
+        interpret=interpret,
+    )(win_blk, meta, alo_w, ahi_w, t0_w, t1_w, alt, tim)[0]
+    return out[:, :4]

@@ -55,6 +55,15 @@ class _WalTail:
         self._offset = 0
         self._checked_head = False
 
+    def at_end(self) -> bool:
+        """True when everything durably appended has been consumed —
+        the read-your-writes gate for mesh offload (a committed write
+        reaches the WAL before its HTTP response)."""
+        try:
+            return os.path.getsize(self.path) <= self._offset
+        except OSError:
+            return not os.path.exists(self.path)
+
     def poll(self) -> List[dict]:
         if not os.path.exists(self.path):
             return []
@@ -98,6 +107,14 @@ class _RegionTail:
         self.client = client
         self._applied = 0
         self.errors = 0  # consecutive fetch failures (operability)
+        self.caught_up = False  # reached head at the last poll
+
+    def at_end(self) -> bool:
+        """Best-effort: head reached at the LAST poll.  Region-mode
+        reads are bounded-stale by design (non-writing instances serve
+        tail-poll state), so mesh offload matches that contract rather
+        than strict read-your-writes."""
+        return self.caught_up
 
     def poll(self) -> List[dict]:
         from dss_tpu.region.client import RegionError, SnapshotRequired
@@ -123,11 +140,14 @@ class _RegionTail:
                         out.extend(recs)
                         self._applied = idx + 1
                 if self._applied >= head:
+                    self.caught_up = True
                     return out
+                self.caught_up = False
         except RegionError as e:
             # transient (next poll retries) — but a replica cut off
             # from the region must be VISIBLY stale, not silently so
             self.errors += 1
+            self.caught_up = False
             log.warning(
                 "replica region tail failed (%d consecutive): %s",
                 self.errors, e,
@@ -391,12 +411,20 @@ class ShardedReplica:
         return time.monotonic() - self._last_fresh
 
     def fresh(self, bound_s: Optional[float] = None) -> bool:
-        """True when the replica synced within `bound_s` (default: 4x
-        the refresh interval) — the offload gate for bounded-staleness
-        reads."""
+        """Mesh-offload gate: the replica must have synced recently,
+        have no un-rebuilt class, AND have consumed the whole log.  For
+        WAL tails `at_end()` stats the file at call time, so a write
+        that committed before this query started is guaranteed visible
+        (read-your-writes); region tails give the same bounded
+        staleness as any non-writing region instance."""
         if bound_s is None:
             bound_s = 4 * getattr(self, "_interval_s", 0.5)
-        return self.staleness_s() <= bound_s
+        if self.staleness_s() > bound_s:
+            return False
+        if any(self._dirty.values()):
+            return False
+        at_end = getattr(self._tail, "at_end", None)
+        return at_end() if at_end is not None else False
 
     def query(
         self,
@@ -408,9 +436,13 @@ class ShardedReplica:
         *,
         now: int,
         cls: str = "ops",
+        owner: Optional[str] = None,
     ) -> List[str]:
         """Entity ids intersecting the query volume, from the current
-        snapshot of `cls` (one atomic snapshot grab per query)."""
+        snapshot of `cls` (one atomic snapshot grab per query).
+        `owner` post-filters to that owner's entities — REQUIRED for
+        the subscription classes, whose ids are owner-private (the
+        store surfaces scope them the same way)."""
         keys = np.asarray(keys, np.int32).ravel()
         if keys.size == 0:
             return []
@@ -425,7 +457,17 @@ class ShardedReplica:
             now=now,
             cls=cls,
         )
-        return rows[0]
+        ids = rows[0]
+        if owner is not None:
+            oid = self._owners.get(owner)
+            recs = self._records[cls]
+            ids = [
+                i for i in ids
+                if oid is not None
+                and i in recs
+                and recs[i].owner_id == oid
+            ]
+        return ids
 
     def query_batch(
         self,

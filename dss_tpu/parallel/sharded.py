@@ -263,7 +263,16 @@ class ShardedDar:
                 ],
                 axis=1,
             )
-        pad = (-qn) % self.dp
+        # bucket the batch axis (pow2, dp-aligned): Q is traffic-
+        # dependent and an unbucketed shape would compile a fresh
+        # multi-chip executable per distinct batch size — stalling
+        # every coalesced caller behind a ~30s jit for each new size
+        bucket = 16
+        while bucket < qn:
+            bucket *= 2
+        if bucket % self.dp:
+            bucket = ((bucket + self.dp - 1) // self.dp) * self.dp
+        pad = bucket - qn
         if pad:
             keys_batch = np.concatenate(
                 [keys_batch, np.full((pad, keys_batch.shape[1]), -1, np.int32)]

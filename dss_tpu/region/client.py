@@ -14,6 +14,11 @@ class RegionError(RuntimeError):
     """Region log unreachable, lease unavailable, or append fenced."""
 
 
+class OptimisticRejected(Exception):
+    """The server definitively refused an optimistic append (cell
+    conflict, live lease, or compacted history) — nothing was logged."""
+
+
 class SnapshotRequired(RegionError):
     """The requested log range was compacted away; fetch the snapshot
     and resume from its index."""
@@ -137,6 +142,37 @@ class RegionClient:
             # explicitly so the lease doesn't leak for its full TTL
             self.release_lease(token)
         return idx
+
+    def append_optimistic(
+        self, expected_head: int, records: List[dict], cells
+    ) -> int:
+        """Lease-free disjoint-cell append -> entry index.  Raises
+        OptimisticRejected when the server turns it down (conflict /
+        lease held / behind compaction) — the caller rolls back and
+        retries via the lease path; RegionError on network failures
+        (append MAY have landed)."""
+        try:
+            r = self._session.post(
+                f"{self.base}/append_optimistic",
+                json={
+                    "expected_head": expected_head,
+                    "records": records,
+                    "cells": sorted(int(c) for c in cells),
+                },
+                timeout=self._timeout,
+            )
+        except requests.RequestException as e:
+            raise RegionError(f"optimistic append failed: {e}") from e
+        if r.status_code == 409:
+            body = self._json(r)
+            raise OptimisticRejected(
+                str(body.get("reason", "conflict"))
+            )
+        if r.status_code != 200:
+            raise RegionError(
+                f"optimistic append rejected: {r.status_code} {r.text}"
+            )
+        return self._field(self._json(r), "index", int, "append_optimistic")
 
     def fetch(
         self, from_index: int

@@ -58,6 +58,7 @@ from typing import List, Optional, Tuple
 
 from dss_tpu import errors
 from dss_tpu.region.client import (
+    OptimisticRejected,
     RegionClient,
     RegionError,
     SnapshotRequired,
@@ -79,6 +80,8 @@ class RegionCoordinator:
         *,
         poll_interval_s: float = 0.05,
         snapshot_every: int = 512,
+        optimistic: bool = True,
+        conflict_backoff_s: float = 2.0,
     ):
         self._client = client
         self._rid = rid_store
@@ -93,6 +96,11 @@ class RegionCoordinator:
         self._dirty = False  # local state diverged; resync required
         self._resyncs = 0
         self._rollbacks = 0
+        self._optimistic = optimistic
+        self._conflict_backoff_s = conflict_backoff_s
+        self._lease_only_until = 0.0
+        self._opt_commits = 0
+        self._opt_conflicts = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -135,14 +143,47 @@ class RegionCoordinator:
             "region_dirty": int(self._dirty),
             "region_resyncs": self._resyncs,
             "region_rollbacks": self._rollbacks,
+            "region_optimistic_commits": self._opt_commits,
+            "region_optimistic_conflicts": self._opt_conflicts,
         }
 
     # -- write-through transaction -------------------------------------------
 
+    @staticmethod
+    def _footprint(buf: List[dict]):
+        """The txn's cell footprint (ints) from its journal records'
+        docs + undo docs, or None when it can't be proven complete.
+        Notification bumps are excluded deliberately: increments
+        commute, so two disjoint-area txns bumping the same spanning
+        subscription serialize correctly in any order."""
+        cells = set()
+        for rec in buf:
+            t = rec.get("t", "")
+            if t.endswith("_bump"):
+                continue
+            got = False
+            doc = rec.get("doc")
+            if isinstance(doc, dict) and doc.get("cells"):
+                cells.update(int(c) for c in doc["cells"])
+                got = True
+            for u in rec.get("undo", []):
+                ud = u.get("doc")
+                if isinstance(ud, dict) and ud.get("cells"):
+                    cells.update(int(c) for c in ud["cells"])
+                    got = True
+            if not got:
+                return None  # can't bound this record's effect
+        return cells
+
     @contextlib.contextmanager
     def txn(self):
-        """Region-serializable transaction (reentrant; the outermost
-        level owns the lease and the batch append)."""
+        """Region-serializable transaction (reentrant).  The
+        outermost level commits via an OPTIMISTIC disjoint-cell append
+        (no lease round trips; disjoint-area writers on different
+        instances proceed in parallel — the CRDB per-range write
+        analog) and falls back to the single write lease after a
+        conflict (lease-only for a cool-down window, since a conflicted
+        optimistic txn cannot be revalidated without re-running it)."""
         with self._lock:
             if self._depth:
                 self._depth += 1
@@ -160,6 +201,30 @@ class RegionCoordinator:
                     self._resync_locked()
                 except RegionError as e:
                     raise errors.unavailable(f"region resync: {e}")
+
+            if self._optimistic and time.monotonic() >= self._lease_only_until:
+                # NO pre-body catch-up round trip: validation runs
+                # against local applied state, and the server checks
+                # every log entry in [our applied index, head) for cell
+                # overlap with this txn's footprint at append time —
+                # exactly the window local state might be missing.  A
+                # disjoint gap cannot affect validation; an overlapping
+                # gap rejects the append and the retry (lease path)
+                # catches up first.
+                self._depth = 1
+                self._buffer = []
+                try:
+                    yield
+                except BaseException:
+                    if self._buffer:
+                        self._rollback_locked(self._buffer)
+                    raise
+                finally:
+                    buf, self._buffer = self._buffer, None
+                    self._depth = 0
+                if buf:
+                    self._commit_optimistic_locked(buf)
+                return
 
             try:
                 token, head = self._client.acquire_lease()
@@ -196,6 +261,75 @@ class RegionCoordinator:
             finally:
                 if not released:
                     self._client.release_lease(token)
+
+    def _commit_optimistic_locked(self, buf: List[dict]) -> None:
+        wire = [
+            {k: v for k, v in rec.items() if k != "undo"} for rec in buf
+        ]
+        cells = self._footprint(buf)
+        if cells is None:
+            # can't prove disjointness: roll back and route the retry
+            # through the lease for a while
+            self._rollback_locked(buf)
+            self._lease_only_until = time.monotonic() + self._conflict_backoff_s
+            e = errors.unavailable(
+                "region txn footprint unknown; retry (lease path)"
+            )
+            e.retryable_write_conflict = True
+            raise e
+        try:
+            idx = self._client.append_optimistic(self._applied, wire, cells)
+        except OptimisticRejected as e:
+            # definite no-append: roll back, cool down to the lease
+            # path (this txn's validation is stale and a txn body can
+            # only run once), surface a retryable 503
+            self._rollback_locked(buf)
+            self._opt_conflicts += 1
+            self._lease_only_until = time.monotonic() + self._conflict_backoff_s
+            err = errors.unavailable(
+                f"region write conflict ({e}); rolled back, retry"
+            )
+            err.retryable_write_conflict = True
+            raise err
+        except RegionError as e:
+            # ambiguous network failure: same convergence story as the
+            # lease path (rollback; tail re-applies if it landed)
+            self._rollback_locked(buf)
+            raise errors.unavailable(
+                f"region append failed; local txn rolled back "
+                f"(re-applied from the log if it landed): {e}"
+            )
+        self._opt_commits += 1
+        if idx == self._applied:
+            self._applied += 1
+            return
+        # disjoint-cell entries interleaved between our validation
+        # point and the append: bring them ALL in (they commute with
+        # our local txn), paging until we reach our own entry at idx
+        # (which is already applied locally and must be skipped)
+        try:
+            while self._applied < idx:
+                entries, _head = self._client.fetch(self._applied)
+                progressed = False
+                for i, recs in entries:
+                    if self._applied <= i < idx:
+                        self._apply_entry_locked(recs)
+                        self._applied = i + 1
+                        progressed = True
+                if not progressed:
+                    raise RegionError(
+                        f"no progress paging gap entries at "
+                        f"{self._applied} (idx {idx})"
+                    )
+        except RegionError as e:
+            # converge via the poller instead: undo ours; the tail
+            # applies everything (theirs + ours) in log order
+            self._rollback_locked(buf)
+            raise errors.unavailable(
+                f"region interleave fetch failed; rolled back, "
+                f"converging via the log: {e}"
+            )
+        self._applied = idx + 1
 
     def _commit_locked(self, token: int, buf: List[dict]) -> None:
         # "undo" lists are local rollback state, not region history

@@ -63,6 +63,10 @@ class RegionLog:
         self._wal = WriteAheadLog(wal_path)
         self._base = 0  # index of _entries[0] (entries below are compacted)
         self._entries: List[List[dict]] = []
+        # per-entry cell footprint (frozenset of ints) or None
+        # (unknown: conflicts with everything) — the serializability
+        # basis for optimistic disjoint-cell appends
+        self._footprints: List[Optional[frozenset]] = []
         self._snap_index = 0
         self._snap_state: Optional[dict] = None
         for rec in self._wal.replay():
@@ -74,11 +78,18 @@ class RegionLog:
                 self._snap_state = rec["state"]
                 self._base = int(rec.get("base", self._snap_index))
                 self._entries = []
+                self._footprints = []
             elif t == "__entry__":
                 self._entries.append(list(rec["recs"]))
+                cells = rec.get("cells")
+                self._footprints.append(
+                    None if cells is None
+                    else frozenset(int(c) for c in cells)
+                )
             else:
                 # legacy flat record (pre-batch log): singleton entry
                 self._entries.append([rec])
+                self._footprints.append(None)
         self._lease_holder: Optional[str] = None
         self._lease_token = 0
         self._lease_expires = 0.0
@@ -134,7 +145,38 @@ class RegionLog:
         idx = self.head
         self._wal.append({"t": "__entry__", "recs": records})
         self._entries.append(list(records))
+        self._footprints.append(None)  # lease appends: footprint unknown
         return idx
+
+    def append_optimistic(self, expected_head: int, records: List[dict],
+                          cells) -> tuple:
+        """Lease-free disjoint-cell append (the CRDB per-range write
+        analog, /root/reference/implementation_details.md:11-42): the
+        writer validated against log state at `expected_head` and
+        declares the txn's cell footprint; the append lands iff no
+        entry since then touches any of those cells (and no lease is
+        live — lease holders assume exclusive append).
+
+        -> ("ok", index) | (reason, None) with reason in
+        {"lease_held", "behind", "ahead", "conflict"}."""
+        if self.lease_holder is not None:
+            return ("lease_held", None)
+        if expected_head < self._base:
+            return ("behind", None)
+        if expected_head > self.head:
+            return ("ahead", None)
+        fp = frozenset(int(c) for c in cells)
+        for i in range(expected_head - self._base, len(self._entries)):
+            other = self._footprints[i]
+            if other is None or (fp & other):
+                return ("conflict", None)
+        idx = self.head
+        self._wal.append(
+            {"t": "__entry__", "recs": records, "cells": sorted(fp)}
+        )
+        self._entries.append(list(records))
+        self._footprints.append(fp)
+        return ("ok", idx)
 
     def fetch(self, from_index: int, limit: int = MAX_FETCH):
         """-> list of [entry_index, records] starting at from_index, or
@@ -166,6 +208,7 @@ class RegionLog:
         drop = index - self._base
         if drop > 0:
             self._entries = self._entries[drop:]
+            self._footprints = self._footprints[drop:]
             self._base = index
         return {
             "head_records": [
@@ -176,7 +219,15 @@ class RegionLog:
                     "state": self._snap_state,
                 }
             ]
-            + [{"t": "__entry__", "recs": e} for e in self._entries],
+            + [
+                dict(
+                    {"t": "__entry__", "recs": e},
+                    **(
+                        {} if fp is None else {"cells": sorted(fp)}
+                    ),
+                )
+                for e, fp in zip(self._entries, self._footprints)
+            ],
             "n_entries": len(self._entries),
         }
 
@@ -217,15 +268,15 @@ class RegionLog:
             return
         fh, seq = staging["fh"], staging["seq"]
         try:
-            for e in self._entries[staging["n"]:]:
+            for e, fp in zip(
+                self._entries[staging["n"]:],
+                self._footprints[staging["n"]:],
+            ):
                 seq += 1
-                fh.write(
-                    json.dumps(
-                        {"t": "__entry__", "recs": e, "seq": seq},
-                        separators=(",", ":"),
-                    )
-                    + "\n"
-                )
+                rec = {"t": "__entry__", "recs": e, "seq": seq}
+                if fp is not None:
+                    rec["cells"] = sorted(fp)
+                fh.write(json.dumps(rec, separators=(",", ":")) + "\n")
             fh.flush()
             os.fsync(fh.fileno())
             fh.close()
@@ -322,6 +373,26 @@ def build_region_app(
             log.release(token)
         return web.json_response({"index": idx, "released": release})
 
+    async def append_optimistic(request):
+        try:
+            body = await request.json()
+            expected_head = int(body.get("expected_head", -1))
+            records = list(body.get("records", []))
+            cells = [int(c) for c in body.get("cells", [])]
+        except (ValueError, TypeError, AttributeError):
+            return web.json_response({"error": "malformed body"}, status=400)
+        if expected_head < 0:
+            return web.json_response(
+                {"error": "expected_head required"}, status=400
+            )
+        status, idx = log.append_optimistic(expected_head, records, cells)
+        if status != "ok":
+            return web.json_response(
+                {"error": status, "reason": status, "head": log.head},
+                status=409,
+            )
+        return web.json_response({"index": idx})
+
     async def records(request):
         try:
             frm = int(request.query.get("from", 0))
@@ -382,6 +453,7 @@ def build_region_app(
     app.router.add_post("/lease", lease_acquire)
     app.router.add_delete("/lease", lease_release)
     app.router.add_post("/append", append)
+    app.router.add_post("/append_optimistic", append_optimistic)
     app.router.add_get("/records", records)
     app.router.add_post("/snapshot", snapshot_put)
     app.router.add_get("/snapshot", snapshot_get)

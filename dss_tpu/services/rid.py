@@ -108,17 +108,20 @@ class RIDService:
             "subscribers": [ser.rid_sub_to_notify_json(s) for s in subs],
         }
 
+    @errors.retry_write_conflicts
     def create_isa(self, id: str, params: dict, owner: str) -> dict:
         return self._put_isa(
             id, None, params.get("extents"), params.get("flights_url", ""), owner
         )
 
+    @errors.retry_write_conflicts
     def update_isa(self, id: str, version: str, params: dict, owner: str) -> dict:
         v = _parse_version(version or "")
         return self._put_isa(
             id, v, params.get("extents"), params.get("flights_url", ""), owner
         )
 
+    @errors.retry_write_conflicts
     def delete_isa(self, id: str, version: str, owner: str) -> dict:
         validate_uuid(id)
         v = _parse_version(version or "")
@@ -237,11 +240,13 @@ class RIDService:
             "service_areas": [ser.isa_to_json(i) for i in isas],
         }
 
+    @errors.retry_write_conflicts
     def create_subscription(self, id: str, params: dict, owner: str) -> dict:
         return self._put_subscription(
             id, None, params.get("callbacks"), params.get("extents"), owner
         )
 
+    @errors.retry_write_conflicts
     def update_subscription(
         self, id: str, version: str, params: dict, owner: str
     ) -> dict:
@@ -250,6 +255,7 @@ class RIDService:
             id, v, params.get("callbacks"), params.get("extents"), owner
         )
 
+    @errors.retry_write_conflicts
     def delete_subscription(self, id: str, version: str, owner: str) -> dict:
         validate_uuid(id)
         _parse_version(version or "")  # must parse; reference app ignores it

@@ -54,6 +54,7 @@ class SCDService:
 
     # -- Operation references ------------------------------------------------
 
+    @errors.retry_write_conflicts
     def put_operation(self, entity_uuid: str, params: dict, owner: str) -> dict:
         if not entity_uuid:
             raise errors.bad_request("missing Operation ID")
@@ -170,6 +171,7 @@ class SCDService:
             op.ovn = ""  # OVNs are private to the owner
         return {"operation_reference": ser.op_to_json(op)}
 
+    @errors.retry_write_conflicts
     def delete_operation(self, entity_uuid: str, owner: str) -> dict:
         if not entity_uuid:
             raise errors.bad_request("missing Operation ID")
@@ -211,6 +213,7 @@ class SCDService:
 
     # -- Subscriptions -------------------------------------------------------
 
+    @errors.retry_write_conflicts
     def put_subscription(self, subscription_id: str, params: dict, owner: str) -> dict:
         if not subscription_id:
             raise errors.bad_request("missing Subscription ID")
@@ -284,6 +287,7 @@ class SCDService:
         subs = self.store.search_subscriptions(cells, owner)
         return {"subscriptions": [ser.scd_sub_to_json(s) for s in subs]}
 
+    @errors.retry_write_conflicts
     def delete_subscription(self, subscription_id: str, owner: str) -> dict:
         if not subscription_id:
             raise errors.bad_request("missing Subscription ID")

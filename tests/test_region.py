@@ -395,6 +395,7 @@ def test_fenced_append_resyncs_and_recovers(region):
     server, stores = region
     svc = RIDService(stores[0].rid, stores[0].clock)
     coord = stores[0].region
+    coord._optimistic = False  # exercising the lease flow explicitly
     real_append = coord._client.append
     calls = {"n": 0}
 
@@ -784,3 +785,127 @@ def test_concurrent_writers_across_instances_converge(region):
         lambda: (len({st.region.applied for st in stores}) == 1) or None,
         deadline_s=10,
     )
+
+
+def test_optimistic_disjoint_writers_skip_the_lease(region):
+    """Disjoint-area writes from different instances commit via the
+    optimistic cell-disjoint append — no lease round trips, full
+    parallelism (the CRDB per-range write analog)."""
+    server, stores = region
+    services = [RIDService(s.rid, s.clock) for s in stores]
+    # far-apart metros: footprints provably disjoint
+    lats = [10.0, 30.0, 50.0]
+    ids = []
+    for i, svc in enumerate(services):
+        isa_id = str(uuid.uuid4())
+        svc.create_isa(
+            isa_id,
+            {
+                "extents": rid_extents(lat=lats[i], lng=-100.0),
+                "flights_url": "https://u.example/f",
+            },
+            f"uss{i}",
+        )
+        ids.append(isa_id)
+    for i, s in enumerate(stores):
+        st = s.region.stats()
+        assert st["region_optimistic_commits"] >= 1, (i, st)
+        assert st["region_optimistic_conflicts"] == 0, (i, st)
+    # convergence: every instance sees every ISA
+    deadline = time.monotonic() + 10
+    for s in stores:
+        svc = RIDService(s.rid, s.clock)
+        for isa_id in ids:
+            while True:
+                try:
+                    svc.get_isa(isa_id)
+                    break
+                except errors.StatusError:
+                    assert time.monotonic() < deadline
+                    time.sleep(0.05)
+
+
+def test_optimistic_conflict_retries_transparently(region):
+    """Same-area writes racing from two instances: the loser's
+    optimistic append is refused, the service retry re-runs it on the
+    lease path, and BOTH writes land (no client-visible failure) —
+    the reference's internal txn-retrier contract."""
+    server, stores = region
+    services = [RIDService(s.rid, s.clock) for s in stores[:2]]
+    n_per = 6
+    failures = []
+    done_ids = [[], []]
+
+    def writer(i):
+        for k in range(n_per):
+            isa_id = str(uuid.uuid4())
+            try:
+                services[i].create_isa(
+                    isa_id,
+                    {
+                        # same metro: overlapping coverings
+                        "extents": rid_extents(lat=37.03, lng=-122.03),
+                        "flights_url": "https://u.example/f",
+                    },
+                    f"uss{i}",
+                )
+                done_ids[i].append(isa_id)
+            except errors.StatusError as e:
+                failures.append((i, k, str(e)))
+
+    ths = [threading.Thread(target=writer, args=(i,)) for i in range(2)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join(timeout=120)
+    assert not failures, failures[:3]
+    assert len(done_ids[0]) == len(done_ids[1]) == n_per
+    # all writes visible everywhere
+    deadline = time.monotonic() + 15
+    for s in stores:
+        svc = RIDService(s.rid, s.clock)
+        for isa_id in done_ids[0] + done_ids[1]:
+            while True:
+                try:
+                    svc.get_isa(isa_id)
+                    break
+                except errors.StatusError:
+                    assert time.monotonic() < deadline
+                    time.sleep(0.05)
+
+
+def test_optimistic_ambiguous_failure_converges(region):
+    """A network-ambiguous optimistic append (it actually landed) rolls
+    back locally and re-applies from the log — no divergence."""
+    server, stores = region
+    svc = RIDService(stores[0].rid, stores[0].clock)
+    coord = stores[0].region
+    real = coord._client.append_optimistic
+    calls = {"n": 0}
+
+    def flaky(expected_head, records, cells):
+        idx = real(expected_head, records, cells)
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RegionError("simulated timeout after landing")
+        return idx
+
+    coord._client.append_optimistic = flaky
+    isa_id = str(uuid.uuid4())
+    with pytest.raises(errors.StatusError) as ei:
+        svc.create_isa(
+            isa_id,
+            {"extents": rid_extents(), "flights_url": "https://u.example/f"},
+            "uss0",
+        )
+    assert ei.value.code == errors.Code.UNAVAILABLE
+    # the append landed: the tail poller re-applies it; reads converge
+    deadline = time.monotonic() + 10
+    while True:
+        try:
+            got = svc.get_isa(isa_id)
+            break
+        except errors.StatusError:
+            assert time.monotonic() < deadline
+            time.sleep(0.05)
+    assert got["service_area"]["id"] == isa_id

@@ -348,6 +348,9 @@ def test_replica_serves_every_entity_class(tmp_path):
     now = int(_t.time() * 1e9) + int(120e9)
     assert rep.query(keys, now=now, cls="isas") == [isa_id]
     assert rep.query(keys, now=now, cls="rid_subs") == [sub_id]
+    # subscription ids are owner-private: scoping filters them
+    assert rep.query(keys, now=now, cls="rid_subs", owner="uss1") == [sub_id]
+    assert rep.query(keys, now=now, cls="rid_subs", owner="uss2") == []
     assert op_id in rep.query(keys, now=now, cls="ops")
     # the put_operation creates an implicit SCD subscription
     assert len(rep.query(keys, now=now, cls="scd_subs")) == 1
