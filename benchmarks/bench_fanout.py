@@ -142,6 +142,9 @@ def main():
     conflicts_before = sum(
         s.region.stats()["region_optimistic_conflicts"] for s in stores
     )
+    commits_before = sum(
+        s.region.stats()["region_optimistic_commits"] for s in stores
+    )
 
     def writer(i):
         lat0 = 10.0 + 20.0 * i  # disjoint metros
@@ -165,8 +168,11 @@ def main():
         t.join()
     dt = time.perf_counter() - t0
     all_l = np.sort(np.concatenate([np.asarray(x) for x in lats]))
-    opt_commits = sum(
-        s.region.stats()["region_optimistic_commits"] for s in stores
+    opt_commits = (
+        sum(
+            s.region.stats()["region_optimistic_commits"] for s in stores
+        )
+        - commits_before
     )
     opt_conflicts = (
         sum(

@@ -1,0 +1,193 @@
+#!/usr/bin/env python
+"""Provision the trust material a DSS deployment needs.
+
+The reference ships build/make-certs.py + apply-certs.sh to mint the
+cross-org CA trust its CockroachDB pool requires
+(/root/reference/implementation_details.md:13-17,
+build/make-certs.py).  This framework's deployment has three trust
+surfaces instead of a CRDB cert pool:
+
+  1. JWT verification keys — the access-token keypair
+     (`--public_key_files` on every DSS instance; the private half
+     feeds `cmds/dummy_oauth` in dev, or stays with the ecosystem's
+     real auth server in prod);
+  2. the region shared token — the bearer secret fencing the region
+     log server's write surface (`--region_token_file` /
+     DSS_REGION_TOKEN);
+  3. TLS — a self-signed CA + server certificate for the region log
+     server / ingress in environments without a platform CA.
+
+Usage:
+  python deploy/make_certs.py --out build/trust [--namespace dss] \
+      [--hosts dss.example.com,region-log.dss.svc]
+
+Writes PEM material under --out and k8s Secret manifests under
+--out/k8s/ (apply with `kubectl apply -f`): the apply-certs.sh analog.
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import datetime
+import os
+import secrets
+
+
+def make_jwt_keypair(out: str):
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    priv = key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption(),
+    )
+    pub = key.public_key().public_bytes(
+        serialization.Encoding.PEM,
+        serialization.PublicFormat.SubjectPublicKeyInfo,
+    )
+    with open(os.path.join(out, "oauth.key"), "wb") as f:
+        f.write(priv)
+    os.chmod(os.path.join(out, "oauth.key"), 0o600)
+    with open(os.path.join(out, "oauth.pem"), "wb") as f:
+        f.write(pub)
+    return priv, pub
+
+
+def make_region_token(out: str) -> str:
+    token = secrets.token_urlsafe(32)
+    path = os.path.join(out, "region.token")
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(token)
+    os.chmod(path, 0o600)
+    return token
+
+
+def make_tls(out: str, hosts):
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    now = datetime.datetime.now(datetime.timezone.utc)
+
+    def name(cn):
+        return x509.Name(
+            [x509.NameAttribute(NameOID.COMMON_NAME, cn)]
+        )
+
+    ca_key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    ca_cert = (
+        x509.CertificateBuilder()
+        .subject_name(name("dss-region-ca"))
+        .issuer_name(name("dss-region-ca"))
+        .public_key(ca_key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now)
+        .not_valid_after(now + datetime.timedelta(days=3650))
+        .add_extension(
+            x509.BasicConstraints(ca=True, path_length=None), critical=True
+        )
+        .sign(ca_key, hashes.SHA256())
+    )
+
+    srv_key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    srv_cert = (
+        x509.CertificateBuilder()
+        .subject_name(name(hosts[0]))
+        .issuer_name(ca_cert.subject)
+        .public_key(srv_key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now)
+        .not_valid_after(now + datetime.timedelta(days=825))
+        .add_extension(
+            x509.SubjectAlternativeName(
+                [x509.DNSName(h) for h in hosts]
+            ),
+            critical=False,
+        )
+        .sign(ca_key, hashes.SHA256())
+    )
+
+    pairs = {
+        "ca.crt": ca_cert.public_bytes(serialization.Encoding.PEM),
+        "server.crt": srv_cert.public_bytes(serialization.Encoding.PEM),
+        "server.key": srv_key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.PKCS8,
+            serialization.NoEncryption(),
+        ),
+    }
+    for fname, data in pairs.items():
+        path = os.path.join(out, fname)
+        with open(path, "wb") as f:
+            f.write(data)
+        if fname.endswith(".key"):
+            os.chmod(path, 0o600)
+    return pairs
+
+
+def k8s_secret(name, namespace, data: dict) -> str:
+    enc = "\n".join(
+        f"  {k}: {base64.b64encode(v if isinstance(v, bytes) else v.encode()).decode()}"
+        for k, v in sorted(data.items())
+    )
+    return (
+        "apiVersion: v1\nkind: Secret\nmetadata:\n"
+        f"  name: {name}\n  namespace: {namespace}\n"
+        "type: Opaque\ndata:\n" + enc + "\n"
+    )
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default="build/trust")
+    ap.add_argument("--namespace", default="dss")
+    ap.add_argument(
+        "--hosts",
+        default="region-log.dss.svc,dss.example.com",
+        help="comma-separated SANs for the TLS server cert",
+    )
+    args = ap.parse_args()
+    os.makedirs(args.out, exist_ok=True)
+    k8s_dir = os.path.join(args.out, "k8s")
+    os.makedirs(k8s_dir, exist_ok=True)
+
+    priv, pub = make_jwt_keypair(args.out)
+    token = make_region_token(args.out)
+    tls = make_tls(args.out, [h for h in args.hosts.split(",") if h])
+
+    manifests = {
+        # name matches the volume in deploy/k8s/dss.yaml; PUBLIC keys
+        # only — every DSS pod mounts this
+        "secret-oauth-public-keys.yaml": k8s_secret(
+            "dss-oauth-public-keys", args.namespace, {"oauth.pem": pub}
+        ),
+        # the signing key is a SEPARATE secret: only the auth server
+        # (dummy_oauth in dev) may mount it — a DSS pod holding it
+        # could mint arbitrary tokens
+        "secret-oauth-signing-key.yaml": k8s_secret(
+            "dss-oauth-signing-key", args.namespace, {"oauth.key": priv}
+        ),
+        "secret-region-token.yaml": k8s_secret(
+            "dss-region-token", args.namespace, {"token": token}
+        ),
+        "secret-region-tls.yaml": k8s_secret(
+            "dss-region-tls", args.namespace, tls
+        ),
+    }
+    for fname, body in manifests.items():
+        with open(os.path.join(k8s_dir, fname), "w", encoding="utf-8") as f:
+            f.write(body)
+
+    print(f"trust material written under {args.out}/")
+    print(f"  JWT keypair:    oauth.key (private) / oauth.pem (public)")
+    print(f"  region token:   region.token")
+    print(f"  TLS:            ca.crt / server.crt / server.key")
+    print(f"apply the k8s secrets with: kubectl apply -f {k8s_dir}/")
+
+
+if __name__ == "__main__":
+    main()

@@ -408,9 +408,14 @@ def build(args) -> web.Application:
                     (args.instance_id or "dss") + "-replica",
                     auth_token=region_token or None,
                 ),
+                # 64 = the mesh-offload min_batch: the first oversized
+                # coalesced batch must hit a warmed jit bucket
+                warm_batches=(1, 64),
             )
         elif args.wal_path:
-            replica = ShardedReplica(mesh, wal_path=args.wal_path)
+            replica = ShardedReplica(
+                mesh, wal_path=args.wal_path, warm_batches=(1, 64)
+            )
         else:
             raise SystemExit(
                 "--sharded_replica needs --wal_path or --region_url "

@@ -172,11 +172,16 @@ class ShardedReplica:
         wal_path: Optional[str] = None,
         region_client=None,
         max_results: int = 512,
+        warm_batches=(1,),
     ):
         if (wal_path is None) == (region_client is None):
             raise ValueError("exactly one of wal_path / region_client")
         self.mesh = mesh
         self.max_results = max_results
+        # batch sizes to warm per rebuild: each maps to a pow2 jit
+        # bucket; mesh-offload consumers add their min_batch so the
+        # first oversized batch after a swap doesn't stall on a compile
+        self.warm_batches = tuple(warm_batches)
         self._tail = (
             _WalTail(wal_path) if wal_path else _RegionTail(region_client)
         )
@@ -359,17 +364,18 @@ class ShardedReplica:
         # so a rebuild can mean a fresh XLA compile — readers keep
         # hitting the old snapshot until the warmed one swaps in
         if dar is not None:
-            try:
-                dar.query_batch(
-                    np.full((1, 16), -1, np.int32),
-                    np.asarray([-np.inf], np.float32),
-                    np.asarray([np.inf], np.float32),
-                    np.asarray([NO_TIME_LO], np.int64),
-                    np.asarray([NO_TIME_HI], np.int64),
-                    now=0,
-                )
-            except Exception:  # noqa: BLE001 — warmup is best-effort
-                pass
+            for wb in self.warm_batches:
+                try:
+                    dar.query_batch(
+                        np.full((wb, 16), -1, np.int32),
+                        np.full(wb, -np.inf, np.float32),
+                        np.full(wb, np.inf, np.float32),
+                        np.full(wb, NO_TIME_LO, np.int64),
+                        np.full(wb, NO_TIME_HI, np.int64),
+                        now=0,
+                    )
+                except Exception:  # noqa: BLE001 — warmup best-effort
+                    pass
         with self._mu:
             self._snapshots[cls] = (dar, ids)
             self._rebuilds += 1

@@ -115,3 +115,43 @@ def test_grafana_dashboard_references_real_metrics():
                     missing.append((p["title"], name))
     assert not missing, f"dashboard references unknown metrics: {missing}"
     assert len(dash["panels"]) >= 8
+
+
+def test_make_certs_provisions_trust_material(tmp_path):
+    """deploy/make_certs.py (the reference's build/make-certs.py +
+    apply-certs.sh analog): JWT keypair, region token, TLS CA chain,
+    and valid k8s Secret manifests."""
+    import subprocess
+    import sys
+
+    out = tmp_path / "trust"
+    r = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(ROOT, "deploy/make_certs.py"),
+            "--out", str(out),
+            "--hosts", "region-log.test.svc",
+        ],
+        capture_output=True,
+        timeout=120,
+    )
+    assert r.returncode == 0, r.stderr.decode()
+    for f in ("oauth.key", "oauth.pem", "region.token", "ca.crt",
+              "server.crt", "server.key"):
+        assert (out / f).exists(), f
+    # private material is 0600
+    assert (out / "oauth.key").stat().st_mode & 0o077 == 0
+    # the JWT keypair actually signs/verifies (the dummy-oauth flow)
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import padding
+
+    priv = serialization.load_pem_private_key(
+        (out / "oauth.key").read_bytes(), None
+    )
+    pub = serialization.load_pem_public_key((out / "oauth.pem").read_bytes())
+    sig = priv.sign(b"claims", padding.PKCS1v15(), hashes.SHA256())
+    pub.verify(sig, b"claims", padding.PKCS1v15(), hashes.SHA256())
+    # k8s manifests parse as Secrets
+    for f in (out / "k8s").iterdir():
+        d = yaml.safe_load(f.read_text())
+        assert d["kind"] == "Secret", f
