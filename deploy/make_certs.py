@@ -34,6 +34,16 @@ import os
 import secrets
 
 
+def _write_private(path: str, data) -> None:
+    """Create key material 0600 from the first byte (no chmod window
+    where a shared-host reader could grab it)."""
+    if isinstance(data, str):
+        data = data.encode()
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    with os.fdopen(fd, "wb") as f:
+        f.write(data)
+
+
 def make_jwt_keypair(out: str):
     from cryptography.hazmat.primitives import serialization
     from cryptography.hazmat.primitives.asymmetric import rsa
@@ -48,9 +58,7 @@ def make_jwt_keypair(out: str):
         serialization.Encoding.PEM,
         serialization.PublicFormat.SubjectPublicKeyInfo,
     )
-    with open(os.path.join(out, "oauth.key"), "wb") as f:
-        f.write(priv)
-    os.chmod(os.path.join(out, "oauth.key"), 0o600)
+    _write_private(os.path.join(out, "oauth.key"), priv)
     with open(os.path.join(out, "oauth.pem"), "wb") as f:
         f.write(pub)
     return priv, pub
@@ -58,10 +66,7 @@ def make_jwt_keypair(out: str):
 
 def make_region_token(out: str) -> str:
     token = secrets.token_urlsafe(32)
-    path = os.path.join(out, "region.token")
-    with open(path, "w", encoding="utf-8") as f:
-        f.write(token)
-    os.chmod(path, 0o600)
+    _write_private(os.path.join(out, "region.token"), token)
     return token
 
 
@@ -120,12 +125,22 @@ def make_tls(out: str, hosts):
             serialization.NoEncryption(),
         ),
     }
+    # persist the CA key (0600, NOT in any k8s secret): rotating or
+    # adding server certs must not force a full CA re-distribution
+    _write_private(
+        os.path.join(out, "ca.key"),
+        ca_key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.PKCS8,
+            serialization.NoEncryption(),
+        ),
+    )
     for fname, data in pairs.items():
-        path = os.path.join(out, fname)
-        with open(path, "wb") as f:
-            f.write(data)
         if fname.endswith(".key"):
-            os.chmod(path, 0o600)
+            _write_private(os.path.join(out, fname), data)
+        else:
+            with open(os.path.join(out, fname), "wb") as f:
+                f.write(data)
     return pairs
 
 
@@ -151,13 +166,16 @@ def main():
         help="comma-separated SANs for the TLS server cert",
     )
     args = ap.parse_args()
+    hosts = [h.strip() for h in args.hosts.split(",") if h.strip()]
+    if not hosts:
+        ap.error("--hosts needs at least one DNS name")
     os.makedirs(args.out, exist_ok=True)
     k8s_dir = os.path.join(args.out, "k8s")
     os.makedirs(k8s_dir, exist_ok=True)
 
     priv, pub = make_jwt_keypair(args.out)
     token = make_region_token(args.out)
-    tls = make_tls(args.out, [h for h in args.hosts.split(",") if h])
+    tls = make_tls(args.out, hosts)
 
     manifests = {
         # name matches the volume in deploy/k8s/dss.yaml; PUBLIC keys
@@ -185,7 +203,7 @@ def main():
     print(f"trust material written under {args.out}/")
     print(f"  JWT keypair:    oauth.key (private) / oauth.pem (public)")
     print(f"  region token:   region.token")
-    print(f"  TLS:            ca.crt / server.crt / server.key")
+    print(f"  TLS:            ca.crt / ca.key / server.crt / server.key")
     print(f"apply the k8s secrets with: kubectl apply -f {k8s_dir}/")
 
 

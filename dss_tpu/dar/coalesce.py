@@ -181,20 +181,32 @@ class QueryCoalescer:
                 and self._mesh_fresh()
             ):
                 try:
-                    results = self._mesh_fn(
-                        [it.keys for it in batch],
-                        np.asarray([it.alt_lo for it in batch], np.float32),
-                        np.asarray([it.alt_hi for it in batch], np.float32),
-                        np.asarray(
-                            [it.t_start for it in batch], np.int64
-                        ),
-                        np.asarray([it.t_end for it in batch], np.int64),
-                        np.asarray([it.now for it in batch], np.int64),
-                    )
+                    # chunk to the warmed jit bucket (the replica warms
+                    # batch=min_batch per rebuild): a 65..4096 batch
+                    # must not stall every caller on a fresh multi-chip
+                    # compile for an unwarmed pow2 bucket
+                    for lo in range(0, b, self._mesh_min):
+                        part = batch[lo : lo + self._mesh_min]
+                        results = self._mesh_fn(
+                            [it.keys for it in part],
+                            np.asarray(
+                                [it.alt_lo for it in part], np.float32
+                            ),
+                            np.asarray(
+                                [it.alt_hi for it in part], np.float32
+                            ),
+                            np.asarray(
+                                [it.t_start for it in part], np.int64
+                            ),
+                            np.asarray(
+                                [it.t_end for it in part], np.int64
+                            ),
+                            np.asarray([it.now for it in part], np.int64),
+                        )
+                        for it, res in zip(part, results):
+                            it.result = res
+                            it.event.set()
                     self.mesh_offloads += 1
-                    for it, res in zip(batch, results):
-                        it.result = res
-                        it.event.set()
                     return
                 except Exception:  # noqa: BLE001 — fall back local
                     import logging

@@ -305,3 +305,152 @@ def test_scd_operation_fixture_requests(stack):
     )
     assert r.status_code == 200, r.text
     assert "operation_references" in r.json()
+
+
+def test_isa_expiry(stack):
+    """prober/rid/test_isa_expiry.py: an expired ISA stays GETtable by
+    id but disappears from search results."""
+    import time as _time
+
+    base, oauth = stack["base"], stack["oauth"]
+    isa_id = str(uuid.uuid4())
+    lat = 44.2
+    body = isa_params(t0=0, t1=6, lat=lat)  # expires in ~6s
+    r = requests.put(
+        f"{base}/v1/dss/identification_service_areas/{isa_id}",
+        json=body,
+        headers=oauth.hdr(RID_SCOPE, sub="uss1"),
+        timeout=5,
+    )
+    assert r.status_code == 200, r.text
+
+    # valid immediately: by id AND by search
+    r = requests.get(
+        f"{base}/v1/dss/identification_service_areas/{isa_id}",
+        headers=oauth.hdr(RID_SCOPE),
+        timeout=5,
+    )
+    assert r.status_code == 200
+    r = requests.get(
+        f"{base}/v1/dss/identification_service_areas",
+        params={"area": area_str(lat=lat)},
+        headers=oauth.hdr(RID_SCOPE),
+        timeout=5,
+    )
+    assert isa_id in [x["id"] for x in r.json()["service_areas"]]
+
+    # wait out the expiry (poll instead of a fixed sleep: a loaded
+    # host must not flake this)
+    deadline = _time.monotonic() + 30
+    while True:
+        r = requests.get(
+            f"{base}/v1/dss/identification_service_areas",
+            params={"area": area_str(lat=lat)},
+            headers=oauth.hdr(RID_SCOPE),
+            timeout=5,
+        )
+        if isa_id not in [x["id"] for x in r.json()["service_areas"]]:
+            break
+        assert _time.monotonic() < deadline, "ISA never expired"
+        _time.sleep(0.5)
+
+    # still returned by id (reference: expired ISAs remain GETtable)...
+    r = requests.get(
+        f"{base}/v1/dss/identification_service_areas/{isa_id}",
+        headers=oauth.hdr(RID_SCOPE),
+        timeout=5,
+    )
+    assert r.status_code == 200
+
+
+def test_subscription_isa_interactions(stack):
+    """prober/rid/test_subscription_isa_interactions.py: the
+    notification-index increments ride the ISA mutation responses with
+    the reference's exact subscriber shape."""
+    base, oauth = stack["base"], stack["oauth"]
+    lat = 45.6
+    isa_id = str(uuid.uuid4())
+    sub_id = str(uuid.uuid4())
+
+    r = requests.put(
+        f"{base}/v1/dss/identification_service_areas/{isa_id}",
+        json=isa_params(lat=lat),
+        headers=oauth.hdr(RID_SCOPE, sub="uss1"),
+        timeout=5,
+    )
+    assert r.status_code == 200, r.text
+
+    # subscription creation response includes the overlapping ISA and
+    # starts at notification_index 0
+    sub_body = {
+        "extents": isa_params(lat=lat)["extents"],
+        "callbacks": {
+            "identification_service_area_url": "https://example.com/foo"
+        },
+    }
+    r = requests.put(
+        f"{base}/v1/dss/subscriptions/{sub_id}",
+        json=sub_body,
+        headers=oauth.hdr(RID_SCOPE, sub="uss2"),
+        timeout=5,
+    )
+    assert r.status_code == 200, r.text
+    data = r.json()
+    assert data["subscription"]["notification_index"] == 0
+    assert isa_id in [x["id"] for x in data["service_areas"]]
+
+    # modifying the ISA bumps the sub to index 1, with the reference's
+    # exact subscriber shape (url + [{notification_index, subscription_id}])
+    r = requests.get(
+        f"{base}/v1/dss/identification_service_areas/{isa_id}",
+        headers=oauth.hdr(RID_SCOPE),
+        timeout=5,
+    )
+    version = r.json()["service_area"]["version"]
+    r = requests.put(
+        f"{base}/v1/dss/identification_service_areas/{isa_id}/{version}",
+        json=isa_params(lat=lat),
+        headers=oauth.hdr(RID_SCOPE, sub="uss1"),
+        timeout=5,
+    )
+    assert r.status_code == 200, r.text
+    assert {
+        "url": "https://example.com/foo",
+        "subscriptions": [
+            {"notification_index": 1, "subscription_id": sub_id},
+        ],
+    } in r.json()["subscribers"]
+
+    # deleting the ISA bumps it to 2
+    r = requests.get(
+        f"{base}/v1/dss/identification_service_areas/{isa_id}",
+        headers=oauth.hdr(RID_SCOPE),
+        timeout=5,
+    )
+    version = r.json()["service_area"]["version"]
+    r = requests.delete(
+        f"{base}/v1/dss/identification_service_areas/{isa_id}/{version}",
+        headers=oauth.hdr(RID_SCOPE, sub="uss1"),
+        timeout=5,
+    )
+    assert r.status_code == 200, r.text
+    assert {
+        "url": "https://example.com/foo",
+        "subscriptions": [
+            {"notification_index": 2, "subscription_id": sub_id},
+        ],
+    } in r.json()["subscribers"]
+
+    # cleanup: delete the subscription at its current version
+    r = requests.get(
+        f"{base}/v1/dss/subscriptions/{sub_id}",
+        headers=oauth.hdr(RID_SCOPE, sub="uss2"),
+        timeout=5,
+    )
+    version = r.json()["subscription"]["version"]
+    r = requests.delete(
+        f"{base}/v1/dss/subscriptions/{sub_id}/{version}",
+        headers=oauth.hdr(RID_SCOPE, sub="uss2"),
+        timeout=5,
+    )
+    assert r.status_code == 200, r.text

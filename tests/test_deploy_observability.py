@@ -155,3 +155,51 @@ def test_make_certs_provisions_trust_material(tmp_path):
     for f in (out / "k8s").iterdir():
         d = yaml.safe_load(f.read_text())
         assert d["kind"] == "Secret", f
+
+
+def test_openapi_spec_covers_every_route():
+    """docs/openapi.yaml is the wire contract (the reference's
+    interfaces/ OpenAPI analog): every route the server registers must
+    appear in the spec with the right method, and vice versa."""
+    from dss_tpu.api.app import build_app
+    from dss_tpu.clock import Clock
+    from dss_tpu.dar.dss_store import DSSStore
+    from dss_tpu.obs.metrics import MetricsRegistry
+    from dss_tpu.services.rid import RIDService
+    from dss_tpu.services.scd import SCDService
+
+    with open(os.path.join(ROOT, "docs/openapi.yaml")) as f:
+        spec = yaml.safe_load(f)
+    spec_ops = {
+        (m.upper(), path)
+        for path, methods in spec["paths"].items()
+        for m in methods
+        if m in ("get", "put", "post", "delete")
+    }
+
+    clock = Clock()
+    store = DSSStore(storage="memory", clock=clock)
+
+    class _FakeReplica:
+        def query(self, *a, **k):
+            return []
+
+        def stats(self):
+            return {}
+
+    app = build_app(
+        RIDService(store.rid, clock),
+        SCDService(store.scd, clock),
+        None,
+        metrics=MetricsRegistry(),
+        profile_dir="/tmp/profiles",
+        replica=_FakeReplica(),
+    )
+    app_ops = set()
+    for route in app.router.routes():
+        if route.method in ("GET", "PUT", "POST", "DELETE"):
+            app_ops.add((route.method, route.resource.canonical))
+    missing_from_spec = app_ops - spec_ops
+    stale_in_spec = spec_ops - app_ops
+    assert not missing_from_spec, missing_from_spec
+    assert not stale_in_spec, stale_in_spec
