@@ -40,6 +40,8 @@ def _write_private(path: str, data) -> None:
     if isinstance(data, str):
         data = data.encode()
     fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    os.fchmod(fd, 0o600)  # a pre-existing wider-mode file keeps its
+    #                       old bits through O_TRUNC otherwise
     with os.fdopen(fd, "wb") as f:
         f.write(data)
 

@@ -408,13 +408,14 @@ def build(args) -> web.Application:
                     (args.instance_id or "dss") + "-replica",
                     auth_token=region_token or None,
                 ),
-                # 64 = the mesh-offload min_batch: the first oversized
-                # coalesced batch must hit a warmed jit bucket
-                warm_batches=(1, 64),
+                # every bucket a mesh-offloaded chunk can land in
+                # (chunks are <= 64; remainders bucket to 16/32): the
+                # first offload must never stall on a compile
+                warm_batches=(1, 32, 64),
             )
         elif args.wal_path:
             replica = ShardedReplica(
-                mesh, wal_path=args.wal_path, warm_batches=(1, 64)
+                mesh, wal_path=args.wal_path, warm_batches=(1, 32, 64)
             )
         else:
             raise SystemExit(

@@ -63,6 +63,8 @@ class QueryCoalescer:
         self._mesh_fn = None
         self._mesh_fresh = None
         self._mesh_min = 64
+        self._mesh_max = 256  # beyond this, ONE local fused dispatch
+        #                       beats serialized mesh chunk round trips
         self.mesh_offloads = 0
 
     def set_mesh_delegate(self, fn, fresh_fn, min_batch: int = 64):
@@ -174,7 +176,7 @@ class QueryCoalescer:
             b = len(batch)
             if (
                 self._mesh_fn is not None
-                and b >= self._mesh_min
+                and self._mesh_min <= b <= self._mesh_max
                 and all(
                     it.allow_stale and it.owner_id < 0 for it in batch
                 )

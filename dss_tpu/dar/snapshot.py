@@ -529,14 +529,13 @@ class DarTable:
 
         if st.snap.fast is not None:
             # small batches answer from the host postings copy (exact,
-            # ~100 us) instead of paying a device round trip; big
-            # batches amortize the trip and win on the device
-            ranges = st.snap.fast.host_candidates(qkeys)
-            if ranges is not None:
-                qidx, slots = st.snap.fast.query_host(
-                    qkeys, alt_lo, alt_hi, t_start, t_end,
-                    now=now_arr, ranges=ranges,
-                )
+            # native C++ when built) instead of paying a device round
+            # trip; big batches amortize the trip and win on the device
+            host = st.snap.fast.query_host_auto(
+                qkeys, alt_lo, alt_hi, t_start, t_end, now=now_arr
+            )
+            if host is not None:
+                qidx, slots = host
             else:
                 if budget.is_host_only():
                     # caller is on the event loop: re-run via executor

@@ -25,7 +25,11 @@ from typing import Optional
 import numpy as np
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(_DIR, "covering.cc")
+_SOURCES = [
+    os.path.join(_DIR, "covering.cc"),
+    os.path.join(_DIR, "hostquery.cc"),
+]
+_SRC = _SOURCES[0]  # kept for back-compat references
 _SO = os.path.join(_DIR, "libdsscover.so")
 
 _load_lock = threading.Lock()   # guards _lib / _load_failed + dlopen
@@ -44,7 +48,7 @@ def _build() -> bool:
         fd, tmp = tempfile.mkstemp(suffix=".so", dir=_DIR)
         os.close(fd)
         subprocess.run(
-            ["g++", "-O2", "-shared", "-fPIC", "-o", tmp, _SRC],
+            ["g++", "-O2", "-shared", "-fPIC", "-o", tmp] + _SOURCES,
             check=True,
             capture_output=True,
             timeout=180,
@@ -61,9 +65,12 @@ def _build() -> bool:
 
 
 def _so_fresh() -> bool:
-    return os.path.exists(_SO) and (
-        not os.path.exists(_SRC)
-        or os.path.getmtime(_SO) >= os.path.getmtime(_SRC)
+    if not os.path.exists(_SO):
+        return False
+    so_mtime = os.path.getmtime(_SO)
+    return all(
+        not os.path.exists(src) or so_mtime >= os.path.getmtime(src)
+        for src in _SOURCES
     )
 
 
@@ -95,6 +102,19 @@ def _try_load() -> Optional[ctypes.CDLL]:
                 ctypes.POINTER(ctypes.c_double),
                 ctypes.POINTER(ctypes.c_uint64),
                 ctypes.c_int64,
+            ]
+            i32p = ctypes.POINTER(ctypes.c_int32)
+            i64p = ctypes.POINTER(ctypes.c_int64)
+            u8p = ctypes.POINTER(ctypes.c_uint8)
+            f32p = ctypes.POINTER(ctypes.c_float)
+            lib.dss_query_host.restype = ctypes.c_int64
+            lib.dss_query_host.argtypes = [
+                i32p, i32p, u8p, ctypes.c_int64,          # postings
+                u8p, f32p, f32p, i64p, i64p,              # slot columns
+                i32p, ctypes.c_int32, ctypes.c_int32,     # qkeys, B, W
+                f32p, f32p, i64p, i64p, i64p,             # query bounds
+                ctypes.c_int64,                           # max_candidates
+                i64p, i32p, ctypes.c_int64,               # out buffers
             ]
             _lib = lib
         except OSError:
@@ -224,3 +244,48 @@ def points_covering(v_xyz: np.ndarray, max_area_km2: float):
     if rc < 0:
         return None
     return out[:rc].copy()
+
+
+def query_host(
+    host_key, host_ent, host_live,
+    slot_live, slot_alo, slot_ahi, slot_t0, slot_t1,
+    qkeys, q_alo, q_ahi, q_t0, q_t1, q_now,
+    max_candidates: int,
+):
+    """Native exact host query -> (qidx i64[N], slot i32[N]), or None
+    when the lib is unavailable or the candidate total says device
+    path.  Inputs must be contiguous arrays of the fastpath dtypes."""
+    lib = _try_load()
+    if lib is None:
+        return None
+    b, w = qkeys.shape
+    cap = int(max_candidates)
+    # reusable per-thread output buffers (same rationale as _out_buf:
+    # a ~768 KB allocation would dwarf the ~15 us kernel)
+    bufs = getattr(_tls, "hq", None)
+    if bufs is None or len(bufs[0]) < cap:
+        bufs = _tls.hq = (
+            np.empty(cap, np.int64), np.empty(cap, np.int32)
+        )
+    out_q, out_s = bufs
+
+    def p(a, ct):
+        return a.ctypes.data_as(ctypes.POINTER(ct))
+
+    rc = lib.dss_query_host(
+        p(host_key, ctypes.c_int32), p(host_ent, ctypes.c_int32),
+        p(host_live, ctypes.c_uint8), np.int64(len(host_key)),
+        p(slot_live, ctypes.c_uint8), p(slot_alo, ctypes.c_float),
+        p(slot_ahi, ctypes.c_float), p(slot_t0, ctypes.c_int64),
+        p(slot_t1, ctypes.c_int64),
+        p(qkeys, ctypes.c_int32), np.int32(b), np.int32(w),
+        p(q_alo, ctypes.c_float), p(q_ahi, ctypes.c_float),
+        p(q_t0, ctypes.c_int64), p(q_t1, ctypes.c_int64),
+        p(q_now, ctypes.c_int64),
+        np.int64(max_candidates),
+        p(out_q, ctypes.c_int64), p(out_s, ctypes.c_int32),
+        np.int64(cap),
+    )
+    if rc < 0:
+        return None
+    return out_q[:rc].copy(), out_s[:rc].copy()

@@ -666,6 +666,55 @@ class FastTable:
             return None
         return lo, hi
 
+    def query_host_auto(
+        self, qkeys, alt_lo, alt_hi, t_start, t_end, *, now,
+    ):
+        """Exact host-path answer for small batches: (qidx, slots), or
+        None when the batch should go to the device.  Prefers the
+        native (C++) kernel — one GIL-released call instead of ~15
+        numpy dispatches (~0.2 ms -> ~15 us at 1k entities, ~3 ms ->
+        ~60 us at 1M); identical verdicts (same compares on the same
+        values), pinned by tests/test_native_hostquery.py.  Falls back
+        to the numpy path when the lib is absent."""
+        if len(qkeys) > self.HOST_MAX_BATCH or self.slot_exact is None:
+            return None
+        try:
+            from dss_tpu import native as _native
+        except Exception:  # pragma: no cover
+            _native = None
+        if _native is not None and _native.available():
+            se = self.slot_exact
+            res = _native.query_host(
+                np.ascontiguousarray(self.host_key, np.int32),
+                np.ascontiguousarray(self.host_ent, np.int32),
+                np.ascontiguousarray(self.host_live).view(np.uint8),
+                np.ascontiguousarray(se["live"]).view(np.uint8),
+                np.ascontiguousarray(se["alt_lo"], np.float32),
+                np.ascontiguousarray(se["alt_hi"], np.float32),
+                np.ascontiguousarray(se["t0"], np.int64),
+                np.ascontiguousarray(se["t1"], np.int64),
+                np.ascontiguousarray(qkeys, np.int32),
+                np.ascontiguousarray(alt_lo, np.float32),
+                np.ascontiguousarray(alt_hi, np.float32),
+                np.ascontiguousarray(t_start, np.int64),
+                np.ascontiguousarray(t_end, np.int64),
+                np.ascontiguousarray(
+                    np.broadcast_to(
+                        np.asarray(now, np.int64), (len(qkeys),)
+                    )
+                ),
+                self.HOST_MAX_CANDIDATES,
+            )
+            if res is None:
+                return None  # candidate gate: device path
+            return res[0], res[1].astype(np.int64)
+        ranges = self.host_candidates(qkeys)
+        if ranges is None:
+            return None
+        return self.query_host(
+            qkeys, alt_lo, alt_hi, t_start, t_end, now=now, ranges=ranges
+        )
+
     def query_host(
         self, qkeys, alt_lo, alt_hi, t_start, t_end, *, now, ranges,
     ) -> Tuple[np.ndarray, np.ndarray]:

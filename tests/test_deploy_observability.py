@@ -203,3 +203,36 @@ def test_openapi_spec_covers_every_route():
     stale_in_spec = spec_ops - app_ops
     assert not missing_from_spec, missing_from_spec
     assert not stale_in_spec, stale_in_spec
+
+
+def test_k8s_manifests_are_structurally_sound():
+    """Parse every deploy/k8s manifest: Secrets/ConfigMaps carry only
+    string data, the region-log StatefulSet keeps its WAL PVC, and
+    every volumeMount has a backing volume."""
+    import glob
+
+    for path in glob.glob(os.path.join(ROOT, "deploy/k8s/*.yaml")):
+        with open(path) as f:
+            docs = [d for d in yaml.safe_load_all(f) if d]
+        for d in docs:
+            if d["kind"] in ("ConfigMap", "Secret"):
+                for k, v in d.get("data", {}).items():
+                    assert isinstance(v, str), (path, d["kind"], k)
+            if d["kind"] in ("Deployment", "StatefulSet"):
+                spec = d["spec"]["template"]["spec"]
+                vols = {v["name"] for v in spec.get("volumes", [])}
+                if d["kind"] == "StatefulSet":
+                    vols |= {
+                        t["metadata"]["name"]
+                        for t in d["spec"].get("volumeClaimTemplates", [])
+                    }
+                for c in spec["containers"]:
+                    for m in c.get("volumeMounts", []):
+                        assert m["name"] in vols, (path, c["name"], m)
+    # the region WAL must be PVC-backed (it IS the region's history)
+    with open(os.path.join(ROOT, "deploy/k8s/region-log.yaml")) as f:
+        sts = [
+            d for d in yaml.safe_load_all(f)
+            if d and d["kind"] == "StatefulSet"
+        ][0]
+    assert sts["spec"]["volumeClaimTemplates"], "region WAL lost its PVC"

@@ -1,0 +1,180 @@
+"""Differential tests: native (C++) host query vs the numpy reference
+path (fastpath.query_host) — identical (qidx, slot) pair multisets
+over random tables, including the candidate-cap device-routing gate.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from dss_tpu import native
+from dss_tpu.dar.oracle import Record
+from dss_tpu.dar.pack import pack_records
+from dss_tpu.ops import fastpath
+from dss_tpu.ops.conflict import NO_TIME_HI, NO_TIME_LO
+from dss_tpu.ops.fastpath import FastTable
+
+pytestmark = pytest.mark.skipif(
+    not native.ensure_built(), reason="native lib unavailable"
+)
+
+HOUR = 3_600_000_000_000
+NOW = 1_700_000_000_000_000_000
+
+
+def _mk_table(rng, n, n_cells=300):
+    recs = []
+    for i in range(n):
+        k = np.unique(rng.integers(0, n_cells, rng.integers(1, 7)))
+        alo = float(rng.uniform(0, 3000))
+        t0 = NOW + int(rng.integers(-5, 5)) * HOUR
+        recs.append(
+            Record(
+                entity_id=f"e{i}",
+                keys=k.astype(np.int32),
+                alt_lo=alo if i % 3 else -np.inf,
+                alt_hi=alo + 350.0 if i % 3 else np.inf,
+                t_start=t0 if i % 4 else NO_TIME_LO,
+                t_end=t0 + 2 * HOUR if i % 4 else NO_TIME_HI,
+                owner_id=i % 5,
+            )
+        )
+    packed = pack_records(recs, pad_postings=False)
+    pe = packed.post_ent
+    ft = FastTable(
+        packed.post_key, pe,
+        packed.alt_lo[pe], packed.alt_hi[pe],
+        packed.t_start[pe], packed.t_end[pe],
+        packed.active[pe],
+        slot_exact={
+            "alt_lo": packed.alt_lo, "alt_hi": packed.alt_hi,
+            "t0": packed.t_start, "t1": packed.t_end,
+            "live": packed.active.copy(),
+        },
+    )
+    return recs, ft
+
+
+def _numpy_pairs(ft, qkeys, alo, ahi, ts, te, now_arr):
+    ranges = ft.host_candidates(qkeys)
+    assert ranges is not None
+    q, s = ft.query_host(
+        qkeys, alo, ahi, ts, te, now=now_arr, ranges=ranges
+    )
+    return sorted(zip(q.tolist(), s.tolist()))
+
+
+def _native_pairs(ft, qkeys, alo, ahi, ts, te, now_arr):
+    se = ft.slot_exact
+    res = native.query_host(
+        np.ascontiguousarray(ft.host_key, np.int32),
+        np.ascontiguousarray(ft.host_ent, np.int32),
+        np.ascontiguousarray(ft.host_live).view(np.uint8),
+        np.ascontiguousarray(se["live"]).view(np.uint8),
+        np.ascontiguousarray(se["alt_lo"], np.float32),
+        np.ascontiguousarray(se["alt_hi"], np.float32),
+        np.ascontiguousarray(se["t0"], np.int64),
+        np.ascontiguousarray(se["t1"], np.int64),
+        np.ascontiguousarray(qkeys, np.int32),
+        np.ascontiguousarray(alo, np.float32),
+        np.ascontiguousarray(ahi, np.float32),
+        np.ascontiguousarray(ts, np.int64),
+        np.ascontiguousarray(te, np.int64),
+        np.ascontiguousarray(now_arr, np.int64),
+        FastTable.HOST_MAX_CANDIDATES,
+    )
+    if res is None:
+        return None
+    return sorted(zip(res[0].tolist(), res[1].tolist()))
+
+
+@pytest.mark.parametrize("seed,n", [(0, 50), (1, 400), (2, 1500)])
+def test_native_host_query_differential(seed, n):
+    rng = np.random.default_rng(seed)
+    recs, ft = _mk_table(rng, n)
+    for trial in range(30):
+        b = int(rng.integers(1, 17))
+        w = 16
+        qkeys = np.full((b, w), -1, np.int32)
+        for i in range(b):
+            u = np.unique(
+                rng.integers(0, 320, rng.integers(1, w)).astype(np.int32)
+            )
+            qkeys[i, : len(u)] = u
+        alo = rng.uniform(-100, 3200, b).astype(np.float32)
+        ahi = (alo + rng.uniform(0, 800, b)).astype(np.float32)
+        alo[::3] = -np.inf
+        ahi[::3] = np.inf
+        ts = (NOW + rng.integers(-6, 2, b) * HOUR).astype(np.int64)
+        te = ts + rng.integers(1, 8, b) * HOUR
+        ts[::4] = NO_TIME_LO
+        te[::4] = NO_TIME_HI
+        now_arr = np.full(b, NOW, np.int64)
+        want = _numpy_pairs(ft, qkeys, alo, ahi, ts, te, now_arr)
+        got = _native_pairs(ft, qkeys, alo, ahi, ts, te, now_arr)
+        assert got == want, (seed, trial)
+
+
+def test_native_candidate_cap_routes_to_device():
+    """When the candidate total exceeds the gate, both paths say
+    'device' (None)."""
+    rng = np.random.default_rng(7)
+    # one hot cell shared by every record -> candidates explode
+    recs = [
+        Record(
+            entity_id=f"e{i}",
+            keys=np.asarray([5], np.int32),
+            alt_lo=-np.inf, alt_hi=np.inf,
+            t_start=NO_TIME_LO, t_end=NO_TIME_HI,
+            owner_id=0,
+        )
+        for i in range(FastTable.HOST_MAX_CANDIDATES + 10)
+    ]
+    packed = pack_records(recs, pad_postings=False)
+    pe = packed.post_ent
+    ft = FastTable(
+        packed.post_key, pe,
+        packed.alt_lo[pe], packed.alt_hi[pe],
+        packed.t_start[pe], packed.t_end[pe],
+        packed.active[pe],
+        slot_exact={
+            "alt_lo": packed.alt_lo, "alt_hi": packed.alt_hi,
+            "t0": packed.t_start, "t1": packed.t_end,
+            "live": packed.active.copy(),
+        },
+    )
+    qkeys = np.full((1, 16), -1, np.int32)
+    qkeys[0, 0] = 5
+    assert ft.host_candidates(qkeys) is None
+    b = np.zeros(1, np.float32)
+    assert (
+        _native_pairs(
+            ft, qkeys, b - np.inf, b + np.inf,
+            np.full(1, NO_TIME_LO, np.int64),
+            np.full(1, NO_TIME_HI, np.int64),
+            np.full(1, NOW, np.int64),
+        )
+        is None
+    )
+
+
+def test_query_host_auto_uses_native_and_matches():
+    """The serving entry point (query_host_auto) produces the same
+    pair sets as the forced numpy path."""
+    rng = np.random.default_rng(9)
+    recs, ft = _mk_table(rng, 600)
+    b, w = 8, 16
+    qkeys = np.full((b, w), -1, np.int32)
+    for i in range(b):
+        u = np.unique(rng.integers(0, 320, 8).astype(np.int32))
+        qkeys[i, : len(u)] = u
+    alo = np.full(b, -np.inf, np.float32)
+    ahi = np.full(b, np.inf, np.float32)
+    ts = np.full(b, NO_TIME_LO, np.int64)
+    te = np.full(b, NO_TIME_HI, np.int64)
+    now_arr = np.full(b, NOW, np.int64)
+    got = ft.query_host_auto(qkeys, alo, ahi, ts, te, now=now_arr)
+    assert got is not None
+    want = _numpy_pairs(ft, qkeys, alo, ahi, ts, te, now_arr)
+    assert sorted(zip(got[0].tolist(), got[1].tolist())) == want
