@@ -160,9 +160,15 @@ def headline(ft, batch, reps, n_cells, width):
         return dt
 
     # the tunneled-TPU environment has heavy run-to-run jitter (±25%
-    # observed on identical code); three passes, best taken, estimates
-    # steady-state throughput rather than one draw from the noise
-    dt_pipe = min(one_pass() for _ in range(3))
+    # observed on identical code, in bad phases 2x, drifting over
+    # minutes); five spaced passes, best taken, estimates steady-state
+    # throughput rather than one draw from the noise
+    passes = []
+    for i in range(5):
+        if i:
+            time.sleep(1.0)
+        passes.append(one_pass())
+    dt_pipe = min(passes)
 
     # single-batch latency (full sync per batch)
     lat = []

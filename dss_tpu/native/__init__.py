@@ -28,6 +28,7 @@ _DIR = os.path.dirname(os.path.abspath(__file__))
 _SOURCES = [
     os.path.join(_DIR, "covering.cc"),
     os.path.join(_DIR, "hostquery.cc"),
+    os.path.join(_DIR, "fastwin.cc"),
 ]
 _SRC = _SOURCES[0]  # kept for back-compat references
 _SO = os.path.join(_DIR, "libdsscover.so")
@@ -48,7 +49,7 @@ def _build() -> bool:
         fd, tmp = tempfile.mkstemp(suffix=".so", dir=_DIR)
         os.close(fd)
         subprocess.run(
-            ["g++", "-O2", "-shared", "-fPIC", "-o", tmp] + _SOURCES,
+            ["g++", "-O3", "-shared", "-fPIC", "-o", tmp] + _SOURCES,
             check=True,
             capture_output=True,
             timeout=180,
@@ -116,6 +117,33 @@ def _try_load() -> Optional[ctypes.CDLL]:
                 ctypes.c_int64,                           # max_candidates
                 i64p, i32p, ctypes.c_int64,               # out buffers
             ]
+            lib.dss_win_ranges.restype = ctypes.c_int64
+            lib.dss_win_ranges.argtypes = [
+                i32p, ctypes.c_int64,                     # host_key
+                i32p, ctypes.c_int64, ctypes.c_int64,     # sample index
+                i32p, ctypes.c_int64,                     # top-level sample
+                i32p, ctypes.c_int64, ctypes.c_int64,     # qkeys, n, block
+                i64p, i64p,                               # lo/hi scratch
+            ]
+            lib.dss_win_expand.restype = ctypes.c_int64
+            lib.dss_win_expand.argtypes = [
+                i64p, i64p, ctypes.c_int64,               # lo, hi, n
+                ctypes.c_int32, ctypes.c_int64,           # w, block
+                i32p, i32p,                               # wins rows
+                i32p, i32p, ctypes.c_int64,               # win_q/blk, cap
+            ]
+            u32p = ctypes.POINTER(ctypes.c_uint32)
+            lib.dss_hit_total.restype = ctypes.c_int64
+            lib.dss_hit_total.argtypes = [u32p, ctypes.c_int64]
+            lib.dss_decode_hits.restype = ctypes.c_int64
+            lib.dss_decode_hits.argtypes = [
+                i32p, u32p, ctypes.c_int64,               # wordpos, bits
+                i32p, i32p,                               # win_q, win_blk
+                ctypes.c_int64, ctypes.c_int64,           # shift, block
+                i32p, ctypes.c_int64,                     # host_ent, P
+                u8p,                                      # slot_live
+                i64p, i64p, ctypes.c_int64,               # out, cap
+            ]
             _lib = lib
         except OSError:
             _load_failed = True
@@ -171,6 +199,11 @@ def _out_buf() -> np.ndarray:
     if buf is None:
         buf = _tls.buf = np.empty(_OUT_CAP, dtype=np.uint64)
     return buf
+
+
+def _ptr(a, ct):
+    """ctypes pointer to a contiguous ndarray's buffer."""
+    return a.ctypes.data_as(ctypes.POINTER(ct))
 
 
 def loop_covering(v_xyz: np.ndarray, area_ok: bool) -> Optional[np.ndarray]:
@@ -269,23 +302,112 @@ def query_host(
         )
     out_q, out_s = bufs
 
-    def p(a, ct):
-        return a.ctypes.data_as(ctypes.POINTER(ct))
-
     rc = lib.dss_query_host(
-        p(host_key, ctypes.c_int32), p(host_ent, ctypes.c_int32),
-        p(host_live, ctypes.c_uint8), np.int64(len(host_key)),
-        p(slot_live, ctypes.c_uint8), p(slot_alo, ctypes.c_float),
-        p(slot_ahi, ctypes.c_float), p(slot_t0, ctypes.c_int64),
-        p(slot_t1, ctypes.c_int64),
-        p(qkeys, ctypes.c_int32), np.int32(b), np.int32(w),
-        p(q_alo, ctypes.c_float), p(q_ahi, ctypes.c_float),
-        p(q_t0, ctypes.c_int64), p(q_t1, ctypes.c_int64),
-        p(q_now, ctypes.c_int64),
+        _ptr(host_key, ctypes.c_int32), _ptr(host_ent, ctypes.c_int32),
+        _ptr(host_live, ctypes.c_uint8), np.int64(len(host_key)),
+        _ptr(slot_live, ctypes.c_uint8), _ptr(slot_alo, ctypes.c_float),
+        _ptr(slot_ahi, ctypes.c_float), _ptr(slot_t0, ctypes.c_int64),
+        _ptr(slot_t1, ctypes.c_int64),
+        _ptr(qkeys, ctypes.c_int32), np.int32(b), np.int32(w),
+        _ptr(q_alo, ctypes.c_float), _ptr(q_ahi, ctypes.c_float),
+        _ptr(q_t0, ctypes.c_int64), _ptr(q_t1, ctypes.c_int64),
+        _ptr(q_now, ctypes.c_int64),
         np.int64(max_candidates),
-        p(out_q, ctypes.c_int64), p(out_s, ctypes.c_int32),
+        _ptr(out_q, ctypes.c_int64), _ptr(out_s, ctypes.c_int32),
         np.int64(cap),
     )
     if rc < 0:
         return None
     return out_q[:rc].copy(), out_s[:rc].copy()
+
+
+def pack_windows(
+    host_key, qk_flat, w: int, block: int, pow2_bucket,
+    sample=None, stride: int = 64, sample0=None,
+):
+    """Native FastTable._pack_windows: postings-range binary searches +
+    window expansion + meta packing in two GIL-released calls (~22 ms
+    -> ~3 ms per 8k-query batch at 1M postings).  Returns
+    (wins, win_q, win_blk, nw) with bit-identical contents to the
+    numpy path, or None when the lib is unavailable.  qk_flat must be
+    contiguous i32; wins pad rows are zero exactly like the numpy
+    path (start == end == 0 -> no lanes match).  sample (optional) is
+    the caller-cached host_key[::stride] copy that keeps the search's
+    top levels L2-resident; sample0 (optional, requires sample) must
+    be sample[::64] — the L1-resident top level (derived on the fly
+    when absent)."""
+    lib = _try_load()
+    if lib is None:
+        return None
+    n = len(qk_flat)
+    scratch = getattr(_tls, "winr", None)
+    if scratch is None or len(scratch[0]) < n:
+        scratch = _tls.winr = (np.empty(n, np.int64), np.empty(n, np.int64))
+    lo, hi = scratch
+
+    if sample is None:
+        sample = np.zeros(0, np.int32)
+    if sample0 is None:
+        sample0 = np.zeros(0, np.int32)
+    nw = lib.dss_win_ranges(
+        _ptr(host_key, ctypes.c_int32), np.int64(len(host_key)),
+        _ptr(sample, ctypes.c_int32), np.int64(len(sample)),
+        np.int64(stride),
+        _ptr(sample0, ctypes.c_int32), np.int64(len(sample0)),
+        _ptr(qk_flat, ctypes.c_int32), np.int64(n), np.int64(block),
+        _ptr(lo, ctypes.c_int64), _ptr(hi, ctypes.c_int64),
+    )
+    if nw == 0:
+        empty = np.zeros(0, np.int32)
+        return None, empty, empty, 0
+    bucket = pow2_bucket(int(nw))
+    wins = np.zeros((2, bucket), np.int32)
+    win_q = np.empty(nw, np.int32)
+    win_blk = np.empty(nw, np.int32)
+    rc = lib.dss_win_expand(
+        _ptr(lo, ctypes.c_int64), _ptr(hi, ctypes.c_int64), np.int64(n),
+        np.int32(w), np.int64(block),
+        _ptr(wins[0], ctypes.c_int32), _ptr(wins[1], ctypes.c_int32),
+        _ptr(win_q, ctypes.c_int32), _ptr(win_blk, ctypes.c_int32),
+        np.int64(nw),
+    )
+    if rc != nw:  # pragma: no cover — count/expand disagreement
+        return None
+    return wins, win_q, win_blk, int(nw)
+
+
+def decode_hits(
+    wordpos, bits_u32, win_q, win_blk,
+    words_shift: int, block: int,
+    host_ent, n_postings: int, slot_live_u8,
+):
+    """Native hit-word decode for FastTable.collect: popcount total +
+    ctz expansion + pad/tombstone filtering in two GIL-released calls
+    (~8 ms -> <1 ms per batch).  Output pairs are in the numpy path's
+    exact order.  Returns (qidx i64[H], slots i64[H]) or None when the
+    lib is unavailable.  All array args must be contiguous."""
+    lib = _try_load()
+    if lib is None:
+        return None
+    n_words = len(wordpos)
+
+    total = lib.dss_hit_total(
+        _ptr(bits_u32, ctypes.c_uint32), np.int64(n_words)
+    )
+    if total == 0:
+        return np.zeros(0, np.int64), np.zeros(0, np.int64)
+    out_q = np.empty(total, np.int64)
+    out_s = np.empty(total, np.int64)
+    rc = lib.dss_decode_hits(
+        _ptr(wordpos, ctypes.c_int32), _ptr(bits_u32, ctypes.c_uint32),
+        np.int64(n_words),
+        _ptr(win_q, ctypes.c_int32), _ptr(win_blk, ctypes.c_int32),
+        np.int64(words_shift), np.int64(block),
+        _ptr(host_ent, ctypes.c_int32), np.int64(n_postings),
+        _ptr(slot_live_u8, ctypes.c_uint8),
+        _ptr(out_q, ctypes.c_int64), _ptr(out_s, ctypes.c_int64),
+        np.int64(total),
+    )
+    if rc < 0:  # pragma: no cover — cap is popcount-exact
+        return None
+    return out_q[:rc], out_s[:rc]

@@ -52,6 +52,22 @@ INT32_MAX = np.int32(2**31 - 1)
 INT32_MIN = np.int32(-(2**31))
 BLOCK = 128  # postings per block == TPU lane width
 
+_NATIVE: Optional[tuple] = None  # one-shot import cache (module|None,)
+
+
+def _native_mod():
+    """The dss_tpu.native module, or None when it can't import.  The
+    import is cached; native.available() stays cheap per call (a lazy
+    dlopen behind a lock-free fast path)."""
+    global _NATIVE
+    if _NATIVE is None:
+        try:
+            from dss_tpu import native
+        except Exception:  # pragma: no cover
+            native = None
+        _NATIVE = (native,)
+    return _NATIVE[0]
+
 
 def segmented_arange(counts: np.ndarray) -> np.ndarray:
     """Ragged expansion: for counts [2, 3] -> [0, 1, 0, 1, 2].  The
@@ -244,6 +260,14 @@ class FastTable:
             raise ValueError(
                 f"FastTable requires non-negative DAR keys, got min "
                 f"{int(post_key.min())}"
+            )
+        # INT32_MAX is the packed-column pad fill, and the native run
+        # search computes key+1 (UB at INT32_MAX); real DAR keys are
+        # 30-bit (geo/s2cell.py), so reject the sentinel outright
+        if P and int(post_key.max()) >= INT32_MAX:
+            raise ValueError(
+                "FastTable requires DAR keys < INT32_MAX "
+                f"(pad sentinel), got max {int(post_key.max())}"
             )
         self.n_postings = P
         # 2 extra blocks of padding so lo_blk+1 never reads out of range
@@ -505,15 +529,45 @@ class FastTable:
     def _pack_windows(self, qkeys: np.ndarray):
         """Expand + pack windows for the fused kernel: one (2, bucket)
         i32 upload [blk, start|end<<8|qidx<<16].  Returns
-        (wins, win_q, win_blk, nw); nw == 0 means no work."""
+        (wins, win_q, win_blk, nw); nw == 0 means no work.
+
+        Prefers the native (C++) kernel — the binary searches + ragged
+        expansion cost ~22 ms per 8k-query batch at 1M postings in
+        numpy vs ~3 ms native, and this is the serial host stage that
+        bounds pipelined fused throughput (bench.py headline).
+        Bit-identical outputs, pinned by tests/test_native_fastwin.py."""
+        if len(qkeys) > (1 << 15):
+            raise ValueError("fused path supports batches up to 32768")
+        nat = _native_mod()
+        if nat is not None and nat.available():
+            qk = np.ascontiguousarray(qkeys, np.int32)
+            hk = np.ascontiguousarray(self.host_key, np.int32)
+            sample = getattr(self, "_hk_sample", None)
+            sample0 = getattr(self, "_hk_sample0", None)
+            if sample is None and len(hk) > 1 << 14:
+                # 1/64- and 1/4096-sampled key columns (~500 KB and
+                # ~8 KB at 8M postings): keep the native search's top
+                # levels cache-resident.  The table is immutable, so
+                # build once and cache.
+                sample = self._hk_sample = np.ascontiguousarray(
+                    hk[::64]
+                )
+                sample0 = self._hk_sample0 = np.ascontiguousarray(
+                    sample[::64]
+                )
+            res = nat.pack_windows(
+                hk, qk.ravel(), qk.shape[1], BLOCK, pow2_bucket,
+                sample=sample, sample0=sample0,
+            )
+            if res is not None:
+                return res
         win_q, _, win_blk, win_start, win_end = self._expand_windows(qkeys)
         nw = len(win_blk)
         if nw == 0:
             return None, win_q, win_blk, 0
-        if len(qkeys) > (1 << 15):
-            # qidx lives in bits 16-31 of a signed i32 meta word; 2^15
-            # keeps the sign bit clear so meta >> 16 recovers it intact
-            raise ValueError("fused path supports batches up to 32768")
+        # qidx lives in bits 16-31 of a signed i32 meta word; the
+        # <= 2^15 batch gate above keeps the sign bit clear so
+        # meta >> 16 recovers it intact
         bucket = pow2_bucket(nw)
         wins = np.zeros((2, bucket), np.int32)
         wins[0, :nw] = win_blk
@@ -615,6 +669,26 @@ class FastTable:
         bits = out[1 + mw : 1 + mw + n_words].astype(np.int32)
         if n_words == 0:
             return np.zeros(0, np.int64), np.zeros(0, np.int64)
+        nat = _native_mod()
+        if nat is not None and nat.available():
+            # native decode: popcount/ctz expansion + pad/tombstone
+            # filter in one GIL-released call, same output order
+            # (differentially pinned by tests/test_native_fastwin.py)
+            wshift = FastTable.WORDS.bit_length() - 1
+            res = nat.decode_hits(
+                np.ascontiguousarray(wordpos, np.int32),
+                np.ascontiguousarray(bits).view(np.uint32),
+                np.ascontiguousarray(pending.win_q, np.int32),
+                np.ascontiguousarray(pending.win_blk, np.int32),
+                wshift, BLOCK,
+                np.ascontiguousarray(self.host_ent, np.int32),
+                self.n_postings,
+                np.ascontiguousarray(self.slot_exact["live"]).view(
+                    np.uint8
+                ),
+            )
+            if res is not None:
+                return res
         # expand hit words -> (word, bit) pairs (popcount + de Bruijn
         # ctz; ~2x unpackbits+flatnonzero)
         wi, bitpos = _expand_hit_words(bits.view(np.uint32))

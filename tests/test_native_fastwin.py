@@ -1,0 +1,196 @@
+"""Differential tests: native (C++) window packing + hit decoding vs
+the numpy reference path (fastpath._pack_windows / _expand_hit_words)
+— bit-identical outputs over random and adversarial tables.  These are
+the two host stages that bound the fused device path's pipelined
+throughput (bench.py headline), so the native kernels must stay
+drop-in exact: same windows, same metas, same hit pairs in the same
+order.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from dss_tpu import native
+from dss_tpu.ops import fastpath
+from dss_tpu.ops.conflict import NO_TIME_HI, NO_TIME_LO
+from dss_tpu.ops.fastpath import BLOCK, FastTable
+
+pytestmark = pytest.mark.skipif(
+    not native.ensure_built(), reason="native lib unavailable"
+)
+
+HOUR = 3_600_000_000_000
+NOW = 1_700_000_000_000_000_000
+
+
+@pytest.fixture(autouse=True)
+def _native_on():
+    """Each test flips between native and numpy itself; make sure the
+    module cache starts (and ends) enabled."""
+    fastpath._NATIVE = None
+    yield
+    fastpath._NATIVE = None
+
+
+def _numpy_pack(ft, qkeys):
+    fastpath._NATIVE = (None,)
+    try:
+        return ft._pack_windows(qkeys)
+    finally:
+        fastpath._NATIVE = None
+
+
+def _mk_ft(rng, n_post, n_cells, hot_cells=0):
+    """A FastTable over random sorted postings; hot_cells get runs
+    spanning several 128-blocks (the multi-window case)."""
+    keys = rng.integers(0, n_cells, n_post).astype(np.int32)
+    if hot_cells:
+        hot = rng.integers(0, n_cells, hot_cells).astype(np.int32)
+        extra = np.repeat(hot, 3 * BLOCK + 17)
+        keys = np.concatenate([keys, extra])
+    keys.sort()
+    n = len(keys)
+    ents = rng.integers(0, max(n // 2, 1), n).astype(np.int32)
+    n_slots = int(ents.max()) + 1 if n else 1
+    alo = rng.uniform(0, 3000, n).astype(np.float32)
+    ahi = alo + 350
+    t0 = np.full(n, NOW - HOUR, np.int64)
+    t1 = np.full(n, NOW + HOUR, np.int64)
+    live = np.ones(n, bool)
+    slot_live = np.ones(n_slots, bool)
+    # a sprinkle of post-build tombstones exercises the decode filter
+    dead = rng.integers(0, n_slots, max(n_slots // 10, 1))
+    slot_live[dead] = False
+    ft = FastTable(
+        keys, ents, alo, ahi, t0, t1, live,
+        slot_exact={
+            "alt_lo": np.full(n_slots, -np.inf, np.float32),
+            "alt_hi": np.full(n_slots, np.inf, np.float32),
+            "t0": np.full(n_slots, NO_TIME_LO, np.int64),
+            "t1": np.full(n_slots, NO_TIME_HI, np.int64),
+            "live": slot_live,
+        },
+    )
+    return ft, n_cells
+
+
+def _mk_queries(rng, b, w, n_cells):
+    qk = rng.integers(-1, n_cells, (b, w)).astype(np.int32)
+    alo = np.full(b, -np.inf, np.float32)
+    ahi = np.full(b, np.inf, np.float32)
+    t0 = np.full(b, NO_TIME_LO, np.int64)
+    t1 = np.full(b, NO_TIME_HI, np.int64)
+    return qk, alo, ahi, t0, t1
+
+
+def _assert_pack_equal(got, want):
+    wins_n, wq_n, wb_n, nw_n = got
+    wins_p, wq_p, wb_p, nw_p = want
+    assert nw_n == nw_p
+    if nw_n == 0:
+        assert wins_n is None and wins_p is None
+        return
+    assert wins_n.dtype == wins_p.dtype and wins_n.shape == wins_p.shape
+    np.testing.assert_array_equal(wins_n, wins_p)
+    np.testing.assert_array_equal(wq_n, wq_p)
+    np.testing.assert_array_equal(wb_n, wb_p)
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_pack_windows_parity_random(seed):
+    rng = np.random.default_rng(seed)
+    ft, n_cells = _mk_ft(rng, 4000, 700, hot_cells=3)
+    qk = rng.integers(-1, n_cells, (257, 5)).astype(np.int32)
+    _assert_pack_equal(ft._pack_windows(qk), _numpy_pack(ft, qk))
+
+
+def test_pack_windows_parity_large_sampled():
+    """Past the 2^14 postings gate the native path uses the cached
+    two-level sample index — the bracketing math is the risky part."""
+    rng = np.random.default_rng(42)
+    ft, n_cells = _mk_ft(rng, 40_000, 2_000, hot_cells=8)
+    assert ft.n_postings > 1 << 14
+    for seed in range(3):
+        rng2 = np.random.default_rng(100 + seed)
+        qk = rng2.integers(-1, n_cells, (512, 8)).astype(np.int32)
+        _assert_pack_equal(ft._pack_windows(qk), _numpy_pack(ft, qk))
+    assert ft._hk_sample is not None and ft._hk_sample0 is not None
+
+
+def test_pack_windows_duplicate_heavy():
+    """Sample entries full of duplicates: runs crossing sample-slice
+    boundaries must still bracket correctly."""
+    rng = np.random.default_rng(7)
+    ft, n_cells = _mk_ft(rng, 30_000, 40, hot_cells=5)  # ~750 posts/cell
+    qk = rng.integers(-1, n_cells, (300, 4)).astype(np.int32)
+    _assert_pack_equal(ft._pack_windows(qk), _numpy_pack(ft, qk))
+
+
+def test_pack_windows_empty_and_miss():
+    rng = np.random.default_rng(3)
+    ft, n_cells = _mk_ft(rng, 2000, 500)
+    # all-pad and all-miss batches
+    qk_pad = np.full((16, 4), -1, np.int32)
+    _assert_pack_equal(ft._pack_windows(qk_pad), _numpy_pack(ft, qk_pad))
+    qk_miss = np.full((16, 4), n_cells + 7, np.int32)
+    _assert_pack_equal(
+        ft._pack_windows(qk_miss), _numpy_pack(ft, qk_miss)
+    )
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_query_fused_end_to_end_parity(seed):
+    """submit+collect through the device with native pack+decode vs
+    the numpy fallback: identical (qidx, slot) sequences."""
+    rng = np.random.default_rng(seed)
+    ft, n_cells = _mk_ft(rng, 6000, 400, hot_cells=2)
+    qb = _mk_queries(rng, 128, 6, n_cells)
+    got = ft.query_fused(*qb, now=NOW)
+    fastpath._NATIVE = (None,)
+    try:
+        want = ft.query_fused(*qb, now=NOW)
+    finally:
+        fastpath._NATIVE = None
+    np.testing.assert_array_equal(got[0], want[0])
+    np.testing.assert_array_equal(got[1], want[1])
+    assert got[0].dtype == want[0].dtype
+    assert got[1].dtype == want[1].dtype
+
+
+def test_decode_drops_tombstones_and_pads():
+    """mark_dead after build: native decode must drop the slot exactly
+    like the numpy path's post-filter."""
+    rng = np.random.default_rng(11)
+    ft, n_cells = _mk_ft(rng, 3000, 300)
+    qb = _mk_queries(rng, 64, 4, n_cells)
+    base_q, base_s = ft.query_fused(*qb, now=NOW)
+    if len(base_s) == 0:
+        pytest.skip("no hits drawn")
+    victim = int(base_s[0])
+    ft.slot_exact["live"][victim] = False
+    got = ft.query_fused(*qb, now=NOW)
+    fastpath._NATIVE = (None,)
+    try:
+        want = ft.query_fused(*qb, now=NOW)
+    finally:
+        fastpath._NATIVE = None
+    np.testing.assert_array_equal(got[0], want[0])
+    np.testing.assert_array_equal(got[1], want[1])
+    assert victim not in got[1]
+
+
+def test_native_wrapper_unavailable_returns_none(monkeypatch):
+    """Library gone -> wrappers return None and callers fall back."""
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_load_failed", True)
+    assert native.pack_windows(
+        np.zeros(4, np.int32), np.zeros(4, np.int32), 2, BLOCK,
+        fastpath.pow2_bucket,
+    ) is None
+    assert native.decode_hits(
+        np.zeros(1, np.int32), np.zeros(1, np.uint32),
+        np.zeros(1, np.int32), np.zeros(1, np.int32), 2, BLOCK,
+        np.zeros(1, np.int32), 1, np.zeros(1, np.uint8),
+    ) is None
