@@ -136,7 +136,8 @@ def main():
             (t0q + hour).astype(np.int64),
         )
 
-    dar = rep._snapshot[0]
+    dar = rep._snapshots["ops"][0]  # the raw ShardedDar (device leg)
+    assert dar is not None
     qb = make_batch(99)
     dar.query_batch(*qb, now=now_ns)  # compile this batch shape
     t0 = time.perf_counter()

@@ -23,6 +23,7 @@ from typing import Callable, Iterator, Optional
 # pkg/rid/cockroach/store.go:165-187).  Logs predating versioning
 # (no head record) read as version 0, which is compatible.
 FORMAT_VERSION = 1
+FORMAT_RECORD_TYPE = "__format__"
 
 
 class LogFormatError(RuntimeError):
@@ -30,13 +31,13 @@ class LogFormatError(RuntimeError):
 
 
 def format_record() -> dict:
-    return {"t": "__format__", "version": FORMAT_VERSION}
+    return {"t": FORMAT_RECORD_TYPE, "version": FORMAT_VERSION}
 
 
 def check_format_record(rec: Optional[dict], path: str) -> None:
     """Raise LogFormatError if the head record declares an unsupported
     version.  rec=None (legacy headerless log) is accepted."""
-    if rec is None or rec.get("t") != "__format__":
+    if rec is None or rec.get("t") != FORMAT_RECORD_TYPE:
         return
     v = rec.get("version", 0)
     if not isinstance(v, int) or v > FORMAT_VERSION:
@@ -110,7 +111,7 @@ class WriteAheadLog:
                 if first:
                     first = False
                     check_format_record(rec, self.path)
-                if rec.get("t") == "__format__":
+                if rec.get("t") == FORMAT_RECORD_TYPE:
                     continue  # gate metadata, not store state
                 yield rec
 

@@ -145,7 +145,10 @@ def _try_load() -> Optional[ctypes.CDLL]:
                 i64p, i64p, ctypes.c_int64,               # out, cap
             ]
             _lib = lib
-        except OSError:
+        except (OSError, AttributeError):
+            # OSError: dlopen failure.  AttributeError: a stale
+            # prebuilt .so missing newer symbols — latch the numpy
+            # fallback instead of re-raising on every request.
             _load_failed = True
         return _lib
 

@@ -95,6 +95,11 @@ class _WalTail:
 
                     _walmod.check_format_record(rec, self.path)
                     self._checked_head = True
+                    # the head is log metadata, not a data record:
+                    # validated here, never surfaced to the applier
+                    if rec.get("t") == _walmod.FORMAT_RECORD_TYPE:
+                        self._offset = fh.tell()
+                        continue
                 out.append(rec)
                 self._offset = fh.tell()
         return out

@@ -52,7 +52,7 @@ from typing import List, Optional
 
 from aiohttp import web
 
-from dss_tpu.dar.wal import WriteAheadLog
+from dss_tpu.dar.wal import FORMAT_RECORD_TYPE, WriteAheadLog
 
 MAX_FETCH = 1000
 MAX_LEASE_TTL_S = 60.0
@@ -71,7 +71,7 @@ class RegionLog:
         self._snap_state: Optional[dict] = None
         for rec in self._wal.replay():
             t = rec.get("t")
-            if t == "__format__":
+            if t == FORMAT_RECORD_TYPE:
                 continue  # version gate runs inside replay()
             if t == "__snapshot__":
                 self._snap_index = int(rec["index"])
