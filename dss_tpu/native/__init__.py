@@ -114,6 +114,9 @@ def _try_load() -> Optional[ctypes.CDLL]:
                 u8p, f32p, f32p, i64p, i64p,              # slot columns
                 i32p, ctypes.c_int32, ctypes.c_int32,     # qkeys, B, W
                 f32p, f32p, i64p, i64p, i64p,             # query bounds
+                i32p, ctypes.c_int64, ctypes.c_int64,     # sample index
+                i32p, ctypes.c_int64,                     # top-level sample
+                i64p, i64p,                               # range scratch
                 ctypes.c_int64,                           # max_candidates
                 i64p, i32p, ctypes.c_int64,               # out buffers
             ]
@@ -287,23 +290,37 @@ def query_host(
     slot_live, slot_alo, slot_ahi, slot_t0, slot_t1,
     qkeys, q_alo, q_ahi, q_t0, q_t1, q_now,
     max_candidates: int,
+    *, sample=None, sample0=None, stride: int = 64,
 ):
     """Native exact host query -> (qidx i64[N], slot i32[N]), or None
     when the lib is unavailable or the candidate total says device
-    path.  Inputs must be contiguous arrays of the fastpath dtypes."""
+    path.  Inputs must be contiguous arrays of the fastpath dtypes.
+    sample / sample0 (optional, see pack_windows) route the range
+    lookups through the cached two-level index instead of flat binary
+    searches — the serving-path lookups share the fused path's index."""
     lib = _try_load()
     if lib is None:
         return None
     b, w = qkeys.shape
     cap = int(max_candidates)
-    # reusable per-thread output buffers (same rationale as _out_buf:
-    # a ~768 KB allocation would dwarf the ~15 us kernel)
+    # reusable per-thread output + range-scratch buffers (same
+    # rationale as _out_buf: a ~768 KB allocation would dwarf the
+    # ~15 us kernel)
     bufs = getattr(_tls, "hq", None)
     if bufs is None or len(bufs[0]) < cap:
         bufs = _tls.hq = (
             np.empty(cap, np.int64), np.empty(cap, np.int32)
         )
     out_q, out_s = bufs
+    n = b * w
+    scratch = getattr(_tls, "hqr", None)
+    if scratch is None or len(scratch[0]) < n:
+        scratch = _tls.hqr = (np.empty(n, np.int64), np.empty(n, np.int64))
+    lo, hi = scratch
+    if sample is None:
+        sample = np.zeros(0, np.int32)
+    if sample0 is None:
+        sample0 = np.zeros(0, np.int32)
 
     rc = lib.dss_query_host(
         _ptr(host_key, ctypes.c_int32), _ptr(host_ent, ctypes.c_int32),
@@ -315,6 +332,10 @@ def query_host(
         _ptr(q_alo, ctypes.c_float), _ptr(q_ahi, ctypes.c_float),
         _ptr(q_t0, ctypes.c_int64), _ptr(q_t1, ctypes.c_int64),
         _ptr(q_now, ctypes.c_int64),
+        _ptr(sample, ctypes.c_int32), np.int64(len(sample)),
+        np.int64(stride),
+        _ptr(sample0, ctypes.c_int32), np.int64(len(sample0)),
+        _ptr(lo, ctypes.c_int64), _ptr(hi, ctypes.c_int64),
         np.int64(max_candidates),
         _ptr(out_q, ctypes.c_int64), _ptr(out_s, ctypes.c_int32),
         np.int64(cap),

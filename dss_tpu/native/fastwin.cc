@@ -60,21 +60,6 @@ inline int64_t lower_bound_range(
 
 }  // namespace
 
-extern "C" {
-
-// Postings-range lookup for n flattened query keys (pad keys -1 find
-// empty ranges).  Fills out_lo/out_hi (caller scratch, length n) and
-// returns the total 128-block window count over non-empty runs —
-// exactly sum((hi-1)/block - lo/block + 1).
-//
-// A flat binary search over millions of postings is memory-latency
-// bound (~8 uncached probes x ~100 ns x 65k keys ~ 20 ms/batch), so
-// the caller passes a 1/stride sampled copy of the key column
-// (sample[i] = host_key[i*stride]; 1M/64 = 64 KB — L2-resident).
-// Each lookup searches the sample, then one stride-sized leaf slice
-// (1-2 cache lines), then finds the run end by galloping forward over
-// the contiguous run — ~2 cold lines per key instead of ~8.  Pass
-// n_sample = 0 to fall back to the flat search (small tables).
 namespace {
 
 // Run end for a key known to start at lo (host_key[lo] == k): gallop
@@ -98,6 +83,53 @@ inline int64_t run_end(
 
 }  // namespace
 
+extern "C" {
+
+// Shared internal (cross-TU within libdsscover.so, not a public API):
+// one key's [lo, hi) postings run via the sampled two-level lower
+// bound + galloping run end.  Pass n_sample = 0 for the flat search.
+void dss_internal_key_run(
+    const int32_t* host_key, int64_t n_post,
+    const int32_t* sample, int64_t n_sample, int64_t stride,
+    const int32_t* sample0, int64_t n_s0, int64_t stride0,
+    int32_t k, int64_t* out_lo, int64_t* out_hi) {
+  int64_t lo;
+  if (n_sample > 0) {
+    int64_t s_lo = 0, s_hi = n_sample;
+    if (n_s0 > 0) {
+      const int64_t j0 = lower_bound_i32(sample0, n_s0, k);
+      s_lo = j0 == 0 ? 0 : (j0 - 1) * stride0 + 1;
+      s_hi = j0 * stride0 + 1;
+      if (s_hi > n_sample) s_hi = n_sample;
+    }
+    const int64_t j = lower_bound_range(sample, s_lo, s_hi, k);
+    const int64_t leaf_lo = j == 0 ? 0 : (j - 1) * stride + 1;
+    int64_t leaf_hi = j * stride + 1;
+    if (leaf_hi > n_post) leaf_hi = n_post;
+    lo = lower_bound_range(host_key, leaf_lo, leaf_hi, k);
+  } else {
+    lo = lower_bound_i32(host_key, n_post, k);
+  }
+  *out_lo = lo;
+  *out_hi = (lo < n_post && host_key[lo] == k)
+                ? run_end(host_key, n_post, lo, k)
+                : lo;
+}
+
+// Postings-range lookup for n flattened query keys (pad keys -1 find
+// empty ranges).  Fills out_lo/out_hi (caller scratch, length n) and
+// returns the total 128-block window count over non-empty runs —
+// exactly sum((hi-1)/block - lo/block + 1).
+//
+// A flat binary search over millions of postings is memory-latency
+// bound (~8 uncached probes x ~100 ns x 65k keys ~ 20 ms/batch), so
+// the caller passes a 1/stride sampled copy of the key column
+// (sample[i] = host_key[i*stride]; 1M/64 = 64 KB — L2-resident).
+// Each lookup searches the sample, then one stride-sized leaf slice
+// (1-2 cache lines), then finds the run end by galloping forward over
+// the contiguous run — ~2 cold lines per key instead of ~8.  Pass
+// n_sample = 0 to fall back to the flat search (small tables).
+
 int64_t dss_win_ranges(
     const int32_t* host_key, int64_t n_post,
     const int32_t* sample, int64_t n_sample, int64_t stride,
@@ -108,13 +140,10 @@ int64_t dss_win_ranges(
   if (n_sample <= 0) {
     // small table: flat searches are already cache-resident
     for (int64_t i = 0; i < n; ++i) {
-      const int32_t k = qkeys[i];
-      const int64_t lo = lower_bound_i32(host_key, n_post, k);
-      const int64_t hi = (lo < n_post && host_key[lo] == k)
-                             ? run_end(host_key, n_post, lo, k)
-                             : lo;
-      out_lo[i] = lo;
-      out_hi[i] = hi;
+      dss_internal_key_run(
+          host_key, n_post, nullptr, 0, 0, nullptr, 0, 0,
+          qkeys[i], &out_lo[i], &out_hi[i]);
+      const int64_t lo = out_lo[i], hi = out_hi[i];
       if (hi > lo) nw += (hi - 1) / block - lo / block + 1;
     }
     return nw;

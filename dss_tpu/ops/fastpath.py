@@ -532,6 +532,23 @@ class FastTable:
         win_end = np.minimum(np.repeat(hi, n_blocks) - blk0, BLOCK).astype(np.int32)
         return win_q, win_key, win_blk, win_start, win_end
 
+    def _sample_index(self):
+        """(host_key i32, sample, sample0) for the native range
+        lookups: 1/64- and 1/4096-sampled key columns (~500 KB and
+        ~8 KB at 8M postings) that keep the search's top levels
+        cache-resident.  The table is immutable, so built once and
+        cached; None samples below 2^14 postings (flat search is
+        already cache-resident)."""
+        hk = np.ascontiguousarray(self.host_key, np.int32)
+        sample = getattr(self, "_hk_sample", None)
+        sample0 = getattr(self, "_hk_sample0", None)
+        if sample is None and len(hk) > 1 << 14:
+            sample = self._hk_sample = np.ascontiguousarray(hk[::64])
+            sample0 = self._hk_sample0 = np.ascontiguousarray(
+                sample[::64]
+            )
+        return hk, sample, sample0
+
     def _pack_windows(self, qkeys: np.ndarray):
         """Expand + pack windows for the fused kernel: one (2, bucket)
         i32 upload [blk, start|end<<8|qidx<<16].  Returns
@@ -547,20 +564,7 @@ class FastTable:
         nat = _native_mod()
         if nat is not None and nat.available():
             qk = np.ascontiguousarray(qkeys, np.int32)
-            hk = np.ascontiguousarray(self.host_key, np.int32)
-            sample = getattr(self, "_hk_sample", None)
-            sample0 = getattr(self, "_hk_sample0", None)
-            if sample is None and len(hk) > 1 << 14:
-                # 1/64- and 1/4096-sampled key columns (~500 KB and
-                # ~8 KB at 8M postings): keep the native search's top
-                # levels cache-resident.  The table is immutable, so
-                # build once and cache.
-                sample = self._hk_sample = np.ascontiguousarray(
-                    hk[::64]
-                )
-                sample0 = self._hk_sample0 = np.ascontiguousarray(
-                    sample[::64]
-                )
+            hk, sample, sample0 = self._sample_index()
             res = nat.pack_windows(
                 hk, qk.ravel(), qk.shape[1], BLOCK, pow2_bucket,
                 sample=sample, sample0=sample0,
@@ -764,8 +768,9 @@ class FastTable:
             _native = None
         if _native is not None and _native.available():
             se = self.slot_exact
+            hk, sample, sample0 = self._sample_index()
             res = _native.query_host(
-                np.ascontiguousarray(self.host_key, np.int32),
+                hk,
                 np.ascontiguousarray(self.host_ent, np.int32),
                 np.ascontiguousarray(self.host_live).view(np.uint8),
                 np.ascontiguousarray(se["live"]).view(np.uint8),
@@ -784,6 +789,7 @@ class FastTable:
                     )
                 ),
                 self.HOST_MAX_CANDIDATES,
+                sample=sample, sample0=sample0,
             )
             if res is None:
                 return None  # candidate gate: device path

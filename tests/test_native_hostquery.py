@@ -178,3 +178,32 @@ def test_query_host_auto_uses_native_and_matches():
     assert got is not None
     want = _numpy_pairs(ft, qkeys, alo, ahi, ts, te, now_arr)
     assert sorted(zip(got[0].tolist(), got[1].tolist())) == want
+
+
+def test_query_host_sampled_index_parity():
+    """Above 2^14 postings query_host_auto routes lookups through the
+    cached two-level sample index (FastTable._sample_index) — the
+    scalar bracketing in dss_internal_key_run's sampled branch, which
+    the small tables above never reach.  Differential vs numpy over a
+    duplicate-heavy key space (runs crossing sample-slice bounds)."""
+    rng = np.random.default_rng(77)
+    recs, ft = _mk_table(rng, 6000, n_cells=150)  # ~24k postings
+    assert ft.n_postings > 1 << 14
+    for seed in range(3):
+        r = np.random.default_rng(200 + seed)
+        b, w = 16, 8
+        qkeys = np.full((b, w), -1, np.int32)
+        for i in range(b):
+            u = np.unique(r.integers(0, 170, 5).astype(np.int32))
+            qkeys[i, : len(u)] = u
+        alo = np.full(b, -np.inf, np.float32)
+        ahi = np.full(b, np.inf, np.float32)
+        ts = np.full(b, NO_TIME_LO, np.int64)
+        te = np.full(b, NO_TIME_HI, np.int64)
+        now_arr = np.full(b, NOW, np.int64)
+        got = ft.query_host_auto(qkeys, alo, ahi, ts, te, now=now_arr)
+        if got is None:
+            continue  # candidate gate tripped: device path
+        want = _numpy_pairs(ft, qkeys, alo, ahi, ts, te, now_arr)
+        assert sorted(zip(got[0].tolist(), got[1].tolist())) == want
+    assert ft._hk_sample is not None and ft._hk_sample0 is not None
