@@ -178,6 +178,30 @@ def _overlay_drop(ov: _Overlay, idx: int) -> Optional[_Overlay]:
     return ov._replace(key=ov.key[keep], ent=ov.ent[keep])
 
 
+def _scatter_hits(out_sets, qidx, slots, ids) -> None:
+    """Distribute deduped (query, slot) hits into out_sets[q] as
+    entity ids.  One vectorized dedup + grouped set.update per query —
+    the per-hit int()/add loop it replaces was ~a third of
+    query_many's host cost at serving batch sizes.  Slots beyond
+    len(ids) (pad lanes) are dropped."""
+    if len(qidx) == 0:
+        return
+    pairs = np.unique(qidx * np.int64(2**32) + slots)
+    qi = (pairs >> np.int64(32)).astype(np.int64)
+    sl = pairs & np.int64(0xFFFFFFFF)
+    ok = sl < len(ids)
+    if not ok.all():
+        qi, sl = qi[ok], sl[ok]
+    # pairs are sorted, so each query's hits are one contiguous run
+    bounds = np.searchsorted(qi, np.arange(len(out_sets) + 1))
+    sl_list = sl.tolist()
+    getter = ids.__getitem__
+    for i in range(len(out_sets)):
+        lo, hi = bounds[i], bounds[i + 1]
+        if hi > lo:
+            out_sets[i].update(map(getter, sl_list[lo:hi]))
+
+
 def _overlay_search(
     ov: _Overlay,
     qkeys: np.ndarray,  # i32[B, W] pad -1
@@ -524,8 +548,17 @@ class DarTable:
         width = max(16, pow2_at_least(max(len(k) for k in keys_list), lo=16))
         qkeys = np.full((b, width), -1, np.int32)
         for i, k in enumerate(keys_list):
-            u = np.unique(np.asarray(k, np.int32))
-            qkeys[i, : len(u)] = u
+            k = np.asarray(k, np.int32)
+            qkeys[i, : len(k)] = k
+        # row-dedup in one vectorized pass instead of per-item
+        # np.unique (a third of this function's host cost at batch 32):
+        # sort each row, then blank repeats to the -1 pad key.  Key
+        # order within a row is irrelevant (set semantics) and pads
+        # find empty postings ranges wherever they sit.
+        qkeys.sort(axis=1)
+        dup = qkeys[:, 1:] == qkeys[:, :-1]
+        if dup.any():
+            qkeys[:, 1:][dup] = -1
 
         if st.snap.fast is not None:
             # small batches answer from the host postings copy (exact,
@@ -554,21 +587,14 @@ class DarTable:
                         st.snap.owner[slots] == owner_ids[qidx]
                     )
                     qidx, slots = qidx[keep], slots[keep]
-            ids = st.snap.ids
-            for p in np.unique(qidx * np.int64(2**32) + slots):
-                i, s = int(p >> 32), int(p & 0xFFFFFFFF)
-                if s < len(ids):
-                    out_sets[i].add(ids[s])
+            _scatter_hits(out_sets, qidx, slots, st.snap.ids)
 
         if st.overlay is not None:
             oq, oent = _overlay_search(
                 st.overlay, qkeys, alt_lo, alt_hi, t_start, t_end,
                 now_arr, owner_ids,
             )
-            oids = st.overlay.ids
-            for p in np.unique(oq * np.int64(2**32) + oent):
-                i, s = int(p >> 32), int(p & 0xFFFFFFFF)
-                out_sets[i].add(oids[s])
+            _scatter_hits(out_sets, oq, oent, st.overlay.ids)
 
         # an entity updated since the snapshot build appears via the
         # overlay only (its old slot is in st.dead); sets dedup any

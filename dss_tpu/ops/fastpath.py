@@ -767,17 +767,33 @@ class FastTable:
         except Exception:  # pragma: no cover
             _native = None
         if _native is not None and _native.available():
-            se = self.slot_exact
-            hk, sample, sample0 = self._sample_index()
+            cols = getattr(self, "_hostq_cols", None)
+            if cols is None:
+                # table-side columns are immutable buffers (tombstones
+                # mutate slot_exact["live"] IN PLACE, and the cached
+                # uint8 view shares its memory) — prepare once.
+                se = self.slot_exact
+                hk, sample, sample0 = self._sample_index()
+                live = np.ascontiguousarray(se["live"])
+                # adopt the contiguous buffer as THE live column:
+                # mark_dead mutates slot_exact["live"] in place, and
+                # the cached uint8 view must see those flips even when
+                # the original input was non-contiguous (where
+                # ascontiguousarray copies)
+                se["live"] = live
+                cols = self._hostq_cols = (
+                    hk,
+                    np.ascontiguousarray(self.host_ent, np.int32),
+                    np.ascontiguousarray(self.host_live).view(np.uint8),
+                    live.view(np.uint8),
+                    np.ascontiguousarray(se["alt_lo"], np.float32),
+                    np.ascontiguousarray(se["alt_hi"], np.float32),
+                    np.ascontiguousarray(se["t0"], np.int64),
+                    np.ascontiguousarray(se["t1"], np.int64),
+                    sample, sample0,
+                )
             res = _native.query_host(
-                hk,
-                np.ascontiguousarray(self.host_ent, np.int32),
-                np.ascontiguousarray(self.host_live).view(np.uint8),
-                np.ascontiguousarray(se["live"]).view(np.uint8),
-                np.ascontiguousarray(se["alt_lo"], np.float32),
-                np.ascontiguousarray(se["alt_hi"], np.float32),
-                np.ascontiguousarray(se["t0"], np.int64),
-                np.ascontiguousarray(se["t1"], np.int64),
+                *cols[:8],
                 np.ascontiguousarray(qkeys, np.int32),
                 np.ascontiguousarray(alt_lo, np.float32),
                 np.ascontiguousarray(alt_hi, np.float32),
@@ -789,7 +805,7 @@ class FastTable:
                     )
                 ),
                 self.HOST_MAX_CANDIDATES,
-                sample=sample, sample0=sample0,
+                sample=cols[8], sample0=cols[9],
             )
             if res is None:
                 return None  # candidate gate: device path
