@@ -454,3 +454,133 @@ def test_subscription_isa_interactions(stack):
         timeout=5,
     )
     assert r.status_code == 200, r.text
+
+
+def test_rid_subscription_validation(stack):
+    """prober/rid/test_subscription_validation.py over the wire:
+    DSS0050 per-area quota (11th subscription in one area -> 429),
+    DSS0060 max duration (>24h -> 400), and footprint validation
+    (empty vertices -> 400), mirroring the reference's expectations
+    (test_create_too_many_subs, test_create_sub_with_too_long_end_time,
+    test_create_sub_empty_vertices)."""
+    base, oauth = stack["base"], stack["oauth"]
+    h = oauth.hdr(RID_SCOPE, sub="quota-uss")
+    lat = 44.25  # an area no other test touches
+
+    def sub_body(**kw):
+        return {
+            "extents": isa_params(lat=lat, **kw)["extents"],
+            "callbacks": {
+                "identification_service_area_url": "https://u.example/i"
+            },
+        }
+
+    # DSS0060: duration beyond 24h is refused outright
+    r = requests.put(
+        f"{base}/v1/dss/subscriptions/{uuid.uuid4()}",
+        json=sub_body(t1=25 * 3600),
+        headers=h,
+        timeout=5,
+    )
+    assert r.status_code == 400, r.text
+
+    # footprint with no vertices is a 400, not a covering crash
+    bad = sub_body()
+    bad["extents"]["spatial_volume"]["footprint"]["vertices"] = []
+    r = requests.put(
+        f"{base}/v1/dss/subscriptions/{uuid.uuid4()}",
+        json=bad,
+        headers=h,
+        timeout=5,
+    )
+    assert r.status_code == 400, r.text
+
+    # DSS0050: ten subscriptions in one area succeed, the eleventh is
+    # rejected 429 and the successful ten remain intact
+    created = []
+    for i in range(10):
+        sid = str(uuid.uuid4())
+        r = requests.put(
+            f"{base}/v1/dss/subscriptions/{sid}",
+            json=sub_body(),
+            headers=h,
+            timeout=5,
+        )
+        assert r.status_code == 200, (i, r.text)
+        created.append((sid, r.json()["subscription"]["version"]))
+    r = requests.put(
+        f"{base}/v1/dss/subscriptions/{uuid.uuid4()}",
+        json=sub_body(),
+        headers=h,
+        timeout=5,
+    )
+    assert r.status_code == 429, r.text
+    r = requests.get(
+        f"{base}/v1/dss/subscriptions",
+        params={"area": area_str(lat=lat)},
+        headers=h,
+        timeout=5,
+    )
+    assert r.status_code == 200
+    got = {s["id"] for s in r.json()["subscriptions"]}
+    assert {sid for sid, _ in created} <= got
+    # quota releases as subscriptions are deleted
+    sid0, ver0 = created[0]
+    r = requests.delete(
+        f"{base}/v1/dss/subscriptions/{sid0}/{ver0}",
+        headers=h,
+        timeout=5,
+    )
+    assert r.status_code == 200, r.text
+    sid_extra = str(uuid.uuid4())
+    r = requests.put(
+        f"{base}/v1/dss/subscriptions/{sid_extra}",
+        json=sub_body(),
+        headers=h,
+        timeout=5,
+    )
+    assert r.status_code == 200, r.text
+    created = created[1:] + [
+        (sid_extra, r.json()["subscription"]["version"])
+    ]
+    # cleanup: leave the area empty so re-runs (and future tests using
+    # this latitude) don't start at full quota
+    for sid, ver in created:
+        r = requests.delete(
+            f"{base}/v1/dss/subscriptions/{sid}/{ver}",
+            headers=h,
+            timeout=5,
+        )
+        assert r.status_code == 200, r.text
+
+
+def test_scd_subscription_id_conversion(stack):
+    """prober/scd/test_subscription_id_conversion.py (reference issue
+    #314): create an SCD subscription under a fixed UUID, then update
+    it with old_version=1 — both PUTs must succeed and keep the same
+    id.  Note the reference accepts a plain-http uss_base_url on
+    explicit subscriptions (only operations' implicit subscriptions
+    validate https, operations_handler.go:221), reproduced here."""
+    base, oauth = stack["base"], stack["oauth"]
+    h = oauth.hdr(SCD_SCOPE, sub="conv-uss")
+    sub_id = "b61a6450-db42-4d0d-91f2-7c1334eda399"
+    url = f"{base}/dss/v1/subscriptions/{sub_id}"
+    body = {
+        "extents": scd_extent(lat=41.68, lng=-91.49),
+        "old_version": 0,
+        "uss_base_url": "http://localhost:12012/services/uss/public/uss/v1/",
+        "notify_for_constraints": True,
+    }
+    r = requests.put(url, json=body, headers=h, timeout=5)
+    assert r.status_code == 200, r.text
+    assert r.json()["subscription"]["id"] == sub_id
+
+    body["extents"] = scd_extent(t0=120, lat=41.68, lng=-91.49)
+    body["old_version"] = 1
+    r = requests.put(url, json=body, headers=h, timeout=5)
+    assert r.status_code == 200, r.text
+    got = r.json()["subscription"]
+    assert got["id"] == sub_id
+    # cleanup so other SCD tests see a clean area
+    r = requests.delete(url, headers=h, timeout=5)
+    assert r.status_code == 200, r.text
