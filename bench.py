@@ -211,20 +211,26 @@ def headline(ft, batch, reps, n_cells, width):
 
     # host allowance measured, not assumed: pack dominates the serial
     # host stage and scales with batch/width exactly like decode does,
-    # so 3x a fresh pack timing + 10 ms tracks the real host+transfer
-    # budget across bench configs
-    t0 = time.perf_counter()
-    ft._pack_windows(batches[0][0])
-    pack_ms = (time.perf_counter() - t0) * 1000
+    # so 3x a pack timing (min of 3 — single draws catch GC pauses)
+    # + 10 ms tracks the real host+transfer budget across configs
+    pack_ms = 1e9
+    for _ in range(3):
+        t0 = time.perf_counter()
+        ft._pack_windows(batches[0][0])
+        pack_ms = min(pack_ms, (time.perf_counter() - t0) * 1000)
     floor_ms = dt_kernel / kreps * 1000 + 3.0 * pack_ms + 10.0
     rounds = [pass_round(5, 1.0)]
     retries = 0
     # small smoke configs are dispatch-RTT-dominated (per-pass overhead
     # dwarfs compute, so the floor model undershoots): detector off
     detect = batch * reps >= 16384
+    # trigger margin vs measured healthy-phase ratios (best-of-5 pass
+    # over this floor): 1.02-1.39 observed across healthy runs at the
+    # default config, so 1.45 only fires below known-achievable
+    # throughput; a false fire costs <=2 bounded retry rounds (~100 s)
     while (
         detect
-        and min(rounds[-1]) / reps * 1000 > 1.8 * floor_ms
+        and min(rounds[-1]) / reps * 1000 > 1.45 * floor_ms
         and retries < 2
     ):
         retries += 1

@@ -1,11 +1,16 @@
 """Native (C++) kernels for the host-side hot paths.
 
-JAX/XLA owns the device compute path; these cover the request-shaping
-work that runs per HTTP call on the host — currently the level-13
-covering (dss_tpu/geo/covering.py), whose numpy implementation costs
-~5 ms/request in small-op dispatch overhead.  The C++ kernel mirrors
-the numpy math operation-for-operation (IEEE double), so results are
-bit-identical; tests/test_native_covering.py pins that differentially.
+JAX/XLA owns the device compute path; these cover the host work around
+it, each mirroring its numpy reference operation-for-operation so
+results are bit-identical (pinned differentially by
+tests/test_native_*.py):
+
+- covering.cc — the level-13 covering fast path (request shaping;
+  ~5 ms/request of numpy small-op dispatch -> ~0.2 ms)
+- hostquery.cc — the exact small-batch serving query over the sorted
+  postings + slot columns (no device round trip)
+- fastwin.cc — the fused device pipeline's window pack + hit decode,
+  plus the shared sampled two-level range lookup both query paths ride
 
 The shared library is built on demand with g++ (make native, or
 lazily at first import).  If the toolchain or build is unavailable the
@@ -42,7 +47,7 @@ _load_failed = False
 
 
 def _build() -> bool:
-    """Compile covering.cc -> libdsscover.so (atomic rename so racing
+    """Compile _SOURCES -> libdsscover.so (atomic rename so racing
     processes never load a half-written .so)."""
     tmp = None
     try:
