@@ -404,6 +404,12 @@ def main():
 
     table = build_table(n_entities, n_cells, kpe)
     ft = table._state.snap.fast
+    # what the server does after boot (cmds/server.py): park the
+    # built table outside gen2 GC scans — the 1M-record heap otherwise
+    # costs ~8 ms of stall per full collection
+    from dss_tpu.runtime import freeze_boot_heap
+
+    freeze_boot_heap()
 
     h = headline(ft, batch, reps, n_cells, width)
 

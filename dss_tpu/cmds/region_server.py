@@ -47,8 +47,11 @@ def build(args) -> web.Application:
 
 
 def main():
+    from dss_tpu.runtime import freeze_boot_heap
+
     args = make_parser().parse_args()
-    app = build(args)
+    app = build(args)  # replays the log in RegionLog.__init__
+    freeze_boot_heap()
     host, _, port = args.addr.rpartition(":")
     web.run_app(app, host=host or "0.0.0.0", port=int(port))
 

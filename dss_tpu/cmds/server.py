@@ -239,7 +239,7 @@ def build_worker(args) -> web.Application:
         out.update(follower.stats())
         return out
 
-    return build_app(
+    app = build_app(
         rid,
         scd,
         authorizer,
@@ -254,6 +254,12 @@ def build_worker(args) -> web.Application:
             args.leader_url, follower=follower
         ),
     )
+    # the worker's boot heap is the initially-replayed WAL; tail
+    # records arriving later stay in normal generations
+    from dss_tpu.runtime import freeze_boot_heap
+
+    freeze_boot_heap()
+    return app
 
 
 def _inline_reads(args) -> bool:
@@ -347,6 +353,7 @@ def build(args) -> web.Application:
     rid = RIDService(store.rid, clock)
     scd = SCDService(store.scd, clock) if args.enable_scd else None
 
+    warm_thread = None
     if args.storage == "tpu" and not args.no_warmup:
         # compile the fused kernel's point-lookup executable in the
         # background so the first real request after boot doesn't burn
@@ -365,7 +372,10 @@ def build(args) -> web.Application:
             except Exception:  # noqa: BLE001 — warmup is best-effort
                 log.exception("fastpath warmup failed")
 
-        threading.Thread(target=_warm, name="fastpath-warmup", daemon=True).start()
+        warm_thread = threading.Thread(
+            target=_warm, name="fastpath-warmup", daemon=True
+        )
+        warm_thread.start()
 
     authorizer = _make_authorizer(args)
 
@@ -437,7 +447,7 @@ def build(args) -> web.Application:
             out.update(replica.stats())
         return out
 
-    return build_app(
+    app = build_app(
         rid,
         scd,
         authorizer,
@@ -454,6 +464,36 @@ def build(args) -> web.Application:
         # proxied mutation
         wal_seq_fn=(lambda: store.wal.seq) if args.workers > 0 else None,
     )
+
+    # park the boot heap outside GC scans once boot actually finishes:
+    # after the background warmup compile (its caches are part of the
+    # boot heap; freezing mid-compile would pin transients instead)
+    # and after the sharded replica's first full log sync (its record
+    # maps are the largest heap in replica mode).  When neither is
+    # pending the freeze runs synchronously, before serving starts.
+    from dss_tpu.runtime import freeze_boot_heap
+
+    def _freeze_after_boot():
+        if warm_thread is not None:
+            warm_thread.join()
+        if replica is not None:
+            deadline = time.monotonic() + 300.0
+            while (
+                replica.staleness_s() == float("inf")
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.5)
+        # a handful of requests may be in flight by now; collect()
+        # first so only their live frames (bounded, one-time) can pin
+        freeze_boot_heap()
+
+    if warm_thread is None and replica is None:
+        freeze_boot_heap()
+    else:
+        threading.Thread(
+            target=_freeze_after_boot, name="gc-freeze", daemon=True
+        ).start()
+    return app
 
 
 def _public_socket(addr: str, reuse_port: bool):
