@@ -29,10 +29,13 @@ Two device implementations:
   - XLA (default): leading-dim block gather (embedding-lookup shape).
   - Pallas (`use_pallas=True`, legacy mask path): explicit
     double-buffered HBM->VMEM DMA per window.  Compiles with the
-    standard Mosaic toolchain; the tunneled remote-compile service in
-    this dev environment cannot compile any Pallas kernel ("failed to
-    legalize func.func" even for trivial kernels), so tests exercise
-    it in interpret mode and the XLA path stays the default here.
+    standard Mosaic toolchain; this dev environment's tunneled
+    remote-compile service (probed r5) compiles only gridless
+    whole-array kernels — any `grid=`, scalar prefetch, manual DMA,
+    or i64 vector crashes it — so the DMA kernels are exercised in
+    interpret mode, a gridless compiled twin
+    (fastpath_pallas.filter_windows_gridless) is parity-pinned on the
+    real chip, and the XLA path stays the default here.
 
 The legacy quantized-mask path (query_batch + exact_filter host
 re-check) is kept as the overflow fallback and the Pallas host.

@@ -7,10 +7,13 @@ grid program handles GROUP=32 consecutive windows (int8 tiling needs
 buffered and running the 4D compare on the VPU.  Equivalent to
 FastTable._filter_xla but with explicit DMA scheduling.
 
-Note: the tunneled remote-compile service in this dev environment
-cannot compile ANY Pallas kernel (Mosaic "failed to legalize
-func.func" even on trivial kernels), so CI exercises this in interpret
-mode (CPU); on directly-attached TPU hardware pass interpret=False.
+Note: this dev environment's tunneled remote-compile service (probed
+round 5) compiles gridless whole-array Pallas kernels but crashes on
+any `grid=`, scalar prefetch, manual DMA, or i64 vectors — so CI
+exercises the DMA kernels in interpret mode (CPU), the gridless twin
+below is compiled + parity-pinned on the real chip
+(DSS_TEST_TPU=1 pytest ...::test_gridless_twin_compiles_on_tpu), and
+on directly-attached TPU hardware pass interpret=False here.
 """
 
 from __future__ import annotations
@@ -117,17 +120,21 @@ def _fused_kernel(blk_ref, meta_ref, alo_ref, ahi_ref, t0_ref, t1_ref,
     base = g * GROUP
 
     def dma_alt(i, slot):
+        # indices must trace as i32: the repo enables jax x64, and
+        # Mosaic's memref_slice rejects i64 operands
+        s = jnp.int32(slot)
         return pltpu.make_async_copy(
             alt_hbm.at[pl.ds(blk_ref[base + i], 1)],
-            alt_scr.at[slot],
-            sems.at[slot, 0],
+            alt_scr.at[s],
+            sems.at[s, jnp.int32(0)],
         )
 
     def dma_time(i, slot):
+        s = jnp.int32(slot)
         return pltpu.make_async_copy(
             time_hbm.at[pl.ds(blk_ref[base + i], 1)],
-            time_scr.at[slot],
-            sems.at[slot, 1],
+            time_scr.at[s],
+            sems.at[s, jnp.int32(1)],
         )
 
     dma_alt(jnp.int32(0), 0).start()
@@ -162,8 +169,12 @@ def _fused_kernel(blk_ref, meta_ref, alo_ref, ahi_ref, t0_ref, t1_ref,
             axis=2,
             dtype=jnp.int32,
         )  # (1, 4)
-        row = jnp.zeros((1, BLOCK), jnp.int32)
-        words_ref[i : i + 1, :] = row.at[:, :4].set(words)
+        # place the 4 words in lanes 0..3 via concat (Mosaic lowers
+        # concatenate; .at[].set scatter has no TPU lowering)
+        row = jnp.concatenate(
+            [words, jnp.zeros((1, BLOCK - 4), jnp.int32)], axis=1
+        )
+        words_ref[i : i + 1, :] = row
 
 
 def qf32_ref_get(ref, i):
@@ -214,3 +225,70 @@ def fused_filter_pack_pallas(
         interpret=interpret,
     )(win_blk, meta, alo_w, ahi_w, t0_w, t1_w, alt, tim)[0]
     return out[:, :4]
+
+
+# ---------------------------------------------------------------------------
+# Gridless compiled twin: the largest Pallas slice this environment's
+# remote Mosaic service can actually compile
+# ---------------------------------------------------------------------------
+#
+# Probed capability matrix of the tunneled compile service (r5):
+#   - whole-array (gridless) kernels over VMEM-resident operands: OK
+#   - ANY `grid=` / BlockSpec pipeline: HTTP 500 (helper crash)
+#   - PrefetchScalarGridSpec scalar prefetch: HTTP 500
+#   - manual DMA (pltpu.make_async_copy): HTTP 500
+#   - i64 vectors in VMEM: HTTP 500
+# So the production-shaped kernels above (grid + hand-scheduled DMA)
+# remain interpret-tested, while this gridless twin compiles and runs
+# on the real chip, pinning the window-filter MATH (the quantized 4D
+# compare of filter_windows_pallas._kernel) compiled-vs-interpret
+# on-device for a VMEM-sized window slice.
+
+
+def _gridless_kernel(win_ref, qk_ref, qalo_ref, qahi_ref, qt0_ref,
+                     qt1_ref, out_ref):
+    win = win_ref[...]  # (NW, 5, BLOCK) i32, pre-gathered by win_blk
+    hit = (
+        (win[:, 0, :] == qk_ref[...])
+        & (win[:, 2, :] >= qalo_ref[...])
+        & (win[:, 1, :] <= qahi_ref[...])
+        & (win[:, 4, :] >= qt0_ref[...])
+        & (win[:, 3, :] <= qt1_ref[...])
+    )
+    out_ref[...] = hit.astype(jnp.int8)
+
+
+# ~2 MB of VMEM operands per call at this bound (NW*5*128 i32 + cols)
+GRIDLESS_MAX_WINDOWS = 512
+
+
+@partial(jax.jit, static_argnames=("interpret",))
+def filter_windows_gridless(
+    p3,  # (NB, 5, 128) i32 block-packed quantized columns
+    win_blk,  # (NW,) i32, NW <= GRIDLESS_MAX_WINDOWS
+    qk,  # (NW,) i32 (negative = never matches)
+    qalo_mm,  # (NW,) i32
+    qahi_mm,
+    qt0s,
+    qt1s,
+    *,
+    interpret: bool = False,
+):
+    """-> per-lane hit mask (NW, 128) int8, same semantics as
+    filter_windows_pallas.  The window gather runs in XLA (data-
+    dependent block fetch needs scalar prefetch, which this env's
+    compiler cannot lower); the filter itself is the compiled Pallas
+    kernel over whole VMEM-resident arrays."""
+    nw = win_blk.shape[0]
+    assert nw <= GRIDLESS_MAX_WINDOWS, "gridless twin is VMEM-bounded"
+    gathered = jnp.take(p3, win_blk, axis=0)  # (NW, 5, 128)
+
+    def col(a):
+        return a.reshape(nw, 1)
+
+    return pl.pallas_call(
+        _gridless_kernel,
+        out_shape=jax.ShapeDtypeStruct((nw, BLOCK), jnp.int8),
+        interpret=interpret,
+    )(gathered, col(qk), col(qalo_mm), col(qahi_mm), col(qt0s),
+      col(qt1s))

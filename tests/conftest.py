@@ -7,12 +7,19 @@ path via __graft_entry__.dryrun_multichip).  Must run before jax import.
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+# DSS_TEST_TPU=1 opts a (selective) pytest run onto the real TPU
+# backend — used for the device-gated tests (e.g. the compiled-Pallas
+# canary test_gridless_twin_compiles_on_tpu); the full suite assumes
+# the 8-device CPU mesh and should not run this way.
+_USE_TPU = os.environ.get("DSS_TEST_TPU") == "1"
+
+if not _USE_TPU:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
 
 # The environment's sitecustomize (axon relay) force-rewrites
 # JAX_PLATFORMS to "axon,cpu", which routes every computation through a
@@ -20,7 +27,8 @@ if "xla_force_host_platform_device_count" not in _flags:
 # config level before any backend initialization.
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if not _USE_TPU:
+    jax.config.update("jax_platforms", "cpu")
 
 
 import pytest

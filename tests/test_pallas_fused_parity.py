@@ -226,3 +226,106 @@ def test_pallas_no_windows_at_all():
         np.full(1, NO_TIME_LO, np.int64), np.full(1, NO_TIME_HI, np.int64),
     )
     assert pw.shape[0] == 0
+
+
+def test_gridless_twin_interpret_parity():
+    """filter_windows_gridless (the compiled-mode twin) matches the
+    legacy DMA kernel's mask bit-for-bit in interpret mode — the
+    everywhere-runnable leg of the compiled-path canary."""
+    from dss_tpu.ops.fastpath import mm_floor, mm_ceil, sec_floor, sec_ceil
+    from dss_tpu.ops.fastpath_pallas import (
+        GRIDLESS_MAX_WINDOWS,
+        GROUP,
+        filter_windows_gridless,
+        filter_windows_pallas,
+    )
+
+    rng = np.random.default_rng(5)
+    _, ft = _mk_table(rng, 1500, 300)
+    qkeys, alo, ahi, ts, te = _mk_queries(rng, 24, 5, 300)
+    win_q, win_key, win_blk, _, _ = ft._expand_windows(qkeys)
+    nw = len(win_blk)
+    assert 0 < nw <= GRIDLESS_MAX_WINDOWS
+    alo_mm = mm_floor(np.where(np.isneginf(alo), -2e6, alo))
+    ahi_mm = mm_ceil(np.where(np.isposinf(ahi), 2e6, ahi))
+    t0s = sec_floor(np.maximum(ts, np.int64(NOW)))
+    t1s = sec_ceil(te)
+    got = np.asarray(
+        filter_windows_gridless(
+            ft.p3,
+            jnp.asarray(win_blk, jnp.int32),
+            jnp.asarray(win_key, jnp.int32),
+            jnp.asarray(alo_mm[win_q], jnp.int32),
+            jnp.asarray(ahi_mm[win_q], jnp.int32),
+            jnp.asarray(t0s[win_q], jnp.int32),
+            jnp.asarray(t1s[win_q], jnp.int32),
+            interpret=True,
+        )
+    )
+    pad = (-nw) % GROUP
+    zpad = np.zeros(pad, np.int32)
+    legacy = np.asarray(
+        filter_windows_pallas(
+            ft.p3,
+            jnp.asarray(np.concatenate([win_blk, zpad]), jnp.int32),
+            jnp.asarray(
+                np.concatenate([win_key, np.full(pad, -2, np.int32)]),
+                jnp.int32,
+            ),
+            jnp.asarray(np.concatenate([alo_mm[win_q], zpad]), jnp.int32),
+            jnp.asarray(np.concatenate([ahi_mm[win_q], zpad]), jnp.int32),
+            jnp.asarray(np.concatenate([t0s[win_q], zpad]), jnp.int32),
+            jnp.asarray(np.concatenate([t1s[win_q], zpad]), jnp.int32),
+            interpret=True,
+        )
+    )
+    np.testing.assert_array_equal(got, legacy[:nw].astype(np.int8))
+
+
+def test_gridless_twin_compiles_on_tpu():
+    """On a real TPU backend (not the CI CPU mesh) the gridless twin
+    must COMPILE (interpret=False) and match interpret mode exactly —
+    the round-5 capability probe found this env's Mosaic service
+    handles gridless whole-array kernels.  Skips off-TPU."""
+    import jax
+
+    if jax.devices()[0].platform not in ("tpu", "axon"):
+        pytest.skip("needs a TPU backend")
+    from dss_tpu.ops.fastpath import mm_floor, mm_ceil, sec_floor, sec_ceil
+    from dss_tpu.ops.fastpath_pallas import (
+        GRIDLESS_MAX_WINDOWS,
+        filter_windows_gridless,
+    )
+
+    rng = np.random.default_rng(9)
+    _, ft = _mk_table(rng, 1200, 250)
+    qkeys, alo, ahi, ts, te = _mk_queries(rng, 16, 4, 250)
+    win_q, win_key, win_blk, _, _ = ft._expand_windows(qkeys)
+    if len(win_blk) == 0 or len(win_blk) > GRIDLESS_MAX_WINDOWS:
+        pytest.skip("window draw out of gridless bounds")
+    alo_mm = mm_floor(np.where(np.isneginf(alo), -2e6, alo))
+    ahi_mm = mm_ceil(np.where(np.isposinf(ahi), 2e6, ahi))
+    t0s = sec_floor(np.maximum(ts, np.int64(NOW)))
+    t1s = sec_ceil(te)
+    args = (
+        ft.p3,
+        jnp.asarray(win_blk, jnp.int32),
+        jnp.asarray(win_key, jnp.int32),
+        jnp.asarray(alo_mm[win_q], jnp.int32),
+        jnp.asarray(ahi_mm[win_q], jnp.int32),
+        jnp.asarray(t0s[win_q], jnp.int32),
+        jnp.asarray(t1s[win_q], jnp.int32),
+    )
+    try:
+        compiled = np.asarray(
+            filter_windows_gridless(*args, interpret=False)
+        )
+    except Exception as e:
+        # skip ONLY on the known environment failure (the tunneled
+        # remote-compile service 500s); anything else is a real kernel
+        # or lowering bug and must fail the test
+        if "remote_compile" in str(e):
+            pytest.skip(f"env Mosaic service unavailable: {type(e).__name__}")
+        raise
+    interp = np.asarray(filter_windows_gridless(*args, interpret=True))
+    np.testing.assert_array_equal(compiled, interp)
