@@ -292,3 +292,87 @@ def filter_windows_gridless(
         interpret=interpret,
     )(gathered, col(qk), col(qalo_mm), col(qahi_mm), col(qt0s),
       col(qt1s))
+
+
+def _gridless_exact_kernel(
+    alo_ref, ahi_ref, t0h_ref, t0l_ref, t1h_ref, t1l_ref,
+    start_ref, end_ref, qalo_ref, qahi_ref,
+    q0h_ref, q0l_ref, q1h_ref, q1l_ref, out_ref,
+):
+    """EXACT fused-path 4D compare, gridless.  Times arrive as split
+    i32 planes (hi = x >> 32 signed; lo' = low 32 bits with the sign
+    bit flipped) because this env's Mosaic service rejects i64
+    vectors: for int64 a, b
+        a >= b  ==  (a_hi > b_hi) | ((a_hi == b_hi) & (a_lo' >= b_lo'))
+    with the lo' bias turning the unsigned low-word compare into a
+    signed one."""
+    lanes = jax.lax.broadcasted_iota(
+        jnp.int32, out_ref.shape, 1
+    )
+    t1h, q0h = t1h_ref[...], q0h_ref[...]
+    t0h, q1h = t0h_ref[...], q1h_ref[...]
+    t1_ge_q0 = (t1h > q0h) | ((t1h == q0h) & (t1l_ref[...] >= q0l_ref[...]))
+    t0_le_q1 = (t0h < q1h) | ((t0h == q1h) & (t0l_ref[...] <= q1l_ref[...]))
+    hit = (
+        (lanes >= start_ref[...])
+        & (lanes < end_ref[...])
+        & (ahi_ref[...] >= qalo_ref[...])
+        & (alo_ref[...] <= qahi_ref[...])
+        & t1_ge_q0
+        & t0_le_q1
+    )
+    out_ref[...] = hit.astype(jnp.int8)
+
+
+def _split_i64(x):
+    """int64 -> (hi i32 signed, lo' i32 = low word with sign bit
+    flipped) such that lexicographic (hi, lo') signed compare equals
+    the i64 compare."""
+    hi = (x >> 32).astype(jnp.int32)
+    lo = jax.lax.bitcast_convert_type(
+        (x & jnp.int64(0xFFFFFFFF)).astype(jnp.uint32), jnp.int32
+    )
+    return hi, lo ^ jnp.int32(-(2**31))
+
+
+@partial(jax.jit, static_argnames=("interpret",))
+def fused_filter_gridless(
+    b_alo,  # (NB, 128) f32 exact block columns
+    b_ahi,
+    b_t0,  # (NB, 128) i64
+    b_t1,
+    win_blk,  # (NW,) i32, NW <= GRIDLESS_MAX_WINDOWS
+    meta,  # (NW,) i32: start | end<<8
+    alo_w,  # (NW,) f32 per-window query bounds
+    ahi_w,
+    t0_w,  # (NW,) i64 (t_start pre-folded with now)
+    t1_w,
+    *,
+    interpret: bool = False,
+):
+    """-> (NW, 128) i8 EXACT hit mask — the production fused path's
+    filter semantics (fused_filter_pack_pallas without the bit-pack),
+    compiled: gathers + i64 splitting run in XLA, the 4D compare is
+    the gridless Pallas kernel."""
+    nw = win_blk.shape[0]
+    assert nw <= GRIDLESS_MAX_WINDOWS, "gridless twin is VMEM-bounded"
+    alo = jnp.take(b_alo, win_blk, axis=0)  # (NW, 128) f32
+    ahi = jnp.take(b_ahi, win_blk, axis=0)
+    t0h, t0l = _split_i64(jnp.take(b_t0, win_blk, axis=0))
+    t1h, t1l = _split_i64(jnp.take(b_t1, win_blk, axis=0))
+    q0h, q0l = _split_i64(t0_w)
+    q1h, q1l = _split_i64(t1_w)
+
+    def col(a):
+        return a.reshape(nw, 1)
+
+    return pl.pallas_call(
+        _gridless_exact_kernel,
+        out_shape=jax.ShapeDtypeStruct((nw, BLOCK), jnp.int8),
+        interpret=interpret,
+    )(
+        alo, ahi, t0h, t0l, t1h, t1l,
+        col(meta & 0xFF), col((meta >> 8) & 0xFF),
+        col(alo_w), col(ahi_w),
+        col(q0h), col(q0l), col(q1h), col(q1l),
+    )
