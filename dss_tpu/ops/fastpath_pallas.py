@@ -10,10 +10,13 @@ FastTable._filter_xla but with explicit DMA scheduling.
 Note: this dev environment's tunneled remote-compile service (probed
 round 5) compiles gridless whole-array Pallas kernels but crashes on
 any `grid=`, scalar prefetch, manual DMA, or i64 vectors — so CI
-exercises the DMA kernels in interpret mode (CPU), the gridless twin
-below is compiled + parity-pinned on the real chip
-(DSS_TEST_TPU=1 pytest ...::test_gridless_twin_compiles_on_tpu), and
-on directly-attached TPU hardware pass interpret=False here.
+exercises the DMA kernels in interpret mode (CPU), while TWO gridless
+twins below are compiled + parity-pinned on the real chip
+(`filter_windows_gridless`, the quantized mask filter, and
+`fused_filter_gridless`, the fused path's exact f32/i64 compare via
+split-i32 time planes; DSS_TEST_TPU=1 pytest
+...::test_*_compiles_on_tpu).  On directly-attached TPU hardware pass
+interpret=False everywhere.
 """
 
 from __future__ import annotations

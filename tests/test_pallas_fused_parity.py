@@ -304,6 +304,22 @@ def _mosaic_service_up() -> bool:
         return False
 
 
+def _compile_or_skip(fn, *args):
+    """Run a compiled (interpret=False) canary; skip only when the
+    remote compile service is actually down (probed with a trivial
+    known-good kernel), fail on genuine lowering/kernel bugs."""
+    try:
+        return np.asarray(fn(*args, interpret=False))
+    except Exception as e:
+        # a remote_compile failure is ambiguous: service outage OR our
+        # kernel crashing the compile helper.  Probe a trivial
+        # known-good kernel to tell them apart; local lowering errors
+        # (VerificationError etc.) fail outright.
+        if "remote_compile" in str(e) and not _mosaic_service_up():
+            pytest.skip(f"env Mosaic service down: {type(e).__name__}")
+        raise
+
+
 def test_gridless_twin_compiles_on_tpu():
     """On a real TPU backend (not the CI CPU mesh) the gridless twin
     must COMPILE (interpret=False) and match interpret mode exactly —
@@ -338,18 +354,7 @@ def test_gridless_twin_compiles_on_tpu():
         jnp.asarray(t0s[win_q], jnp.int32),
         jnp.asarray(t1s[win_q], jnp.int32),
     )
-    try:
-        compiled = np.asarray(
-            filter_windows_gridless(*args, interpret=False)
-        )
-    except Exception as e:
-        # a remote_compile failure is ambiguous: service outage OR our
-        # kernel crashing the compile helper.  Probe a trivial
-        # known-good kernel to tell them apart; local lowering errors
-        # (VerificationError etc.) fail outright.
-        if "remote_compile" in str(e) and not _mosaic_service_up():
-            pytest.skip(f"env Mosaic service down: {type(e).__name__}")
-        raise
+    compiled = _compile_or_skip(filter_windows_gridless, *args)
     interp = np.asarray(filter_windows_gridless(*args, interpret=True))
     np.testing.assert_array_equal(compiled, interp)
 
@@ -417,16 +422,5 @@ def test_exact_gridless_compiles_on_tpu():
     from dss_tpu.ops.fastpath_pallas import fused_filter_gridless
 
     args, oracle = _exact_gridless_args_and_oracle(4)
-    try:
-        compiled = np.asarray(
-            fused_filter_gridless(*args, interpret=False)
-        )
-    except Exception as e:
-        # a remote_compile failure is ambiguous: service outage OR our
-        # kernel crashing the compile helper.  Probe a trivial
-        # known-good kernel to tell them apart; local lowering errors
-        # (VerificationError etc.) fail outright.
-        if "remote_compile" in str(e) and not _mosaic_service_up():
-            pytest.skip(f"env Mosaic service down: {type(e).__name__}")
-        raise
+    compiled = _compile_or_skip(fused_filter_gridless, *args)
     np.testing.assert_array_equal(compiled, oracle)
