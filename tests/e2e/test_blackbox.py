@@ -21,7 +21,7 @@ import uuid
 
 import requests
 
-from tests.e2e.conftest import Proc, free_port, wait_healthy
+from tests.e2e.conftest import AUD, Proc, free_port, wait_healthy
 
 RID_SCOPE = (
     "dss.read.identification_service_areas "
@@ -310,18 +310,7 @@ def test_region_two_instance_interop_over_http(region_stack):
     assert r.status_code == 200, r.text
     version = r.json()["service_area"]["version"]
 
-    deadline = time.monotonic() + VISIBILITY_DEADLINE_S
-    while True:
-        r = requests.get(
-            f"{b}/v1/dss/identification_service_areas/{isa_id}",
-            headers=oauth.hdr(RID_SCOPE),
-            timeout=5,
-        )
-        if r.status_code == 200:
-            assert r.json()["service_area"]["version"] == version
-            break
-        assert time.monotonic() < deadline, "ISA never visible on B"
-        time.sleep(0.05)
+    _wait_visible(b, isa_id, oauth, version=version)
 
     # SCD: USS1 -> instance A; USS2 -> instance B without the key: 409
     op1, op2 = str(uuid.uuid4()), str(uuid.uuid4())
@@ -472,3 +461,147 @@ def test_sharded_replica_surface(certs, oauth, tmp_path_factory):
         )
     finally:
         p.stop()
+
+
+def test_region_log_server_crash_and_recovery(
+    certs, oauth, tmp_path_factory
+):
+    """Failure detection + recovery at the process level (SURVEY.md
+    §5): SIGKILL the region log server mid-region.  Instances keep
+    serving reads (bounded staleness), writes fail with a 5xx instead
+    of corrupting state, and after the log server restarts on the same
+    WAL the region resumes: old data intact, new writes commit and
+    replicate cross-instance."""
+    wal = tmp_path_factory.mktemp("regioncrash") / "region.wal"
+    log_port = free_port()
+    log_base = f"http://127.0.0.1:{log_port}"
+
+    log_procs = []
+
+    def launch_log():
+        p = Proc(
+            [
+                "dss_tpu.cmds.region_server",
+                "--addr", f":{log_port}",
+                "--wal_path", str(wal),
+            ],
+            "region-server-crash",
+        )
+        log_procs.append(p)  # tracked before health wait: no leak path
+        wait_healthy(f"{log_base}/healthy", p.p, "region-server-crash")
+        return p
+
+    instances, bases = [], []
+    try:
+        log_proc = launch_log()
+        for i in range(2):
+            port = free_port()
+            p = Proc(
+                [
+                    "dss_tpu.cmds.server",
+                    "--addr", f":{port}",
+                    "--enable_scd",
+                    "--storage", "memory",
+                    "--region_url", log_base,
+                    "--region_poll_interval", "0.02",
+                    "--instance_id", f"crash-dss-{i}",
+                    "--public_key_files", str(certs / "oauth.pem"),
+                    "--accepted_jwt_audiences", AUD,
+                ],
+                f"crash-dss-{i}",
+            )
+            instances.append(p)
+            bases.append(f"http://127.0.0.1:{port}")
+        for i, b in enumerate(bases):
+            wait_healthy(f"{b}/healthy", instances[i].p, f"crash-dss-{i}")
+        a, b = bases
+        lat = 46.3
+
+        isa1 = str(uuid.uuid4())
+        r = requests.put(
+            f"{a}/v1/dss/identification_service_areas/{isa1}",
+            json=isa_params(lat=lat),
+            headers=oauth.hdr(RID_SCOPE),
+            timeout=5,
+        )
+        assert r.status_code == 200, r.text
+        _wait_visible(b, isa1, oauth)
+
+        # hard-kill the log server (no drain, no snapshot upload)
+        log_proc.p.kill()
+        log_proc.p.wait(timeout=10)
+
+        # writes now fail loudly with a 5xx...
+        isa_failed = str(uuid.uuid4())
+        r = requests.put(
+            f"{a}/v1/dss/identification_service_areas/{isa_failed}",
+            json=isa_params(lat=lat + 0.5),
+            headers=oauth.hdr(RID_SCOPE),
+            timeout=15,
+        )
+        assert r.status_code >= 500, r.text
+        # ...while reads keep serving the replicated state
+        r = requests.get(
+            f"{a}/v1/dss/identification_service_areas/{isa1}",
+            headers=oauth.hdr(RID_SCOPE),
+            timeout=5,
+        )
+        assert r.status_code == 200, r.text
+
+        # restart on the same WAL: the region resumes
+        log_proc = launch_log()
+        isa2 = str(uuid.uuid4())
+        deadline = time.monotonic() + 20.0
+        while True:
+            r = requests.put(
+                f"{a}/v1/dss/identification_service_areas/{isa2}",
+                json=isa_params(lat=lat + 1.0),
+                headers=oauth.hdr(RID_SCOPE),
+                timeout=15,
+            )
+            if r.status_code == 200:
+                break
+            assert time.monotonic() < deadline, (
+                f"write never recovered: {r.status_code} {r.text}"
+            )
+            time.sleep(0.25)
+        # old data intact everywhere, new write replicates to B, and
+        # the failed-during-outage write was rolled back, not
+        # half-applied (undo-list rollback, region/coordinator.py)
+        for base in (a, b):
+            r = requests.get(
+                f"{base}/v1/dss/identification_service_areas/{isa1}",
+                headers=oauth.hdr(RID_SCOPE),
+                timeout=5,
+            )
+            assert r.status_code == 200, (base, r.text)
+            r = requests.get(
+                f"{base}/v1/dss/identification_service_areas/{isa_failed}",
+                headers=oauth.hdr(RID_SCOPE),
+                timeout=5,
+            )
+            assert r.status_code == 404, (base, r.text)
+        _wait_visible(b, isa2, oauth)
+    finally:
+        for p in instances:
+            p.stop()
+        for p in log_procs:
+            p.stop()
+
+
+def _wait_visible(base, isa_id, oauth, version=None):
+    """Poll until the ISA is GETtable on `base` (bounded-staleness
+    replication deadline); optionally pin the replicated version."""
+    deadline = time.monotonic() + VISIBILITY_DEADLINE_S
+    while True:
+        r = requests.get(
+            f"{base}/v1/dss/identification_service_areas/{isa_id}",
+            headers=oauth.hdr(RID_SCOPE),
+            timeout=5,
+        )
+        if r.status_code == 200:
+            if version is not None:
+                assert r.json()["service_area"]["version"] == version
+            return
+        assert time.monotonic() < deadline, f"{isa_id} never visible"
+        time.sleep(0.05)
