@@ -133,6 +133,20 @@ class JWKSResolver:
 # -- authorizer --------------------------------------------------------------
 
 
+
+def _same_keys(a: list, b: list) -> bool:
+    """Key-set equality by public numbers (key objects are recreated
+    on every JWKS resolve, so identity never matches)."""
+    if len(a) != len(b):
+        return False
+    try:
+        return [k.public_numbers() for k in a] == [
+            k.public_numbers() for k in b
+        ]
+    except Exception:  # non-RSA key objects: be conservative
+        return False
+
+
 class Authorizer:
     """Validates bearer tokens and enforces per-operation scopes.
 
@@ -158,6 +172,16 @@ class Authorizer:
         self.now = now
         self._lock = threading.RLock()
         self._keys = resolver.resolve()
+        # successful-signature cache: RS256 verify costs ~40 us of RSA
+        # math per call and USS tokens repeat for up to an hour, so
+        # cache token -> payload per key GENERATION (any key swap bumps
+        # the generation and orphans old entries).  Claims (exp/nbf/
+        # aud/scopes) are still validated on EVERY request downstream —
+        # only the pure signature->payload function is cached.  Only
+        # successes are cached (a flood of distinct bad tokens can't
+        # grow it) and the size is capped.
+        self._sig_gen = 0
+        self._sig_cache: Dict[str, dict] = {}
         self._stop = threading.Event()
         self._refresher = None
         if refresh_interval_s:
@@ -177,26 +201,51 @@ class Authorizer:
             try:
                 keys = self._resolver.resolve()
                 with self._lock:
+                    changed = not _same_keys(keys, self._keys)
                     self._keys = keys
+                    if changed:
+                        # flush only on a REAL rotation: periodic
+                        # refreshes resolving the same keys must not
+                        # discard an hour's worth of cached verifies
+                        self._sig_gen += 1
+                        self._sig_cache = {}
             except Exception:
                 pass  # keep serving the previous keys
 
     def refresh_keys(self):
         keys = self._resolver.resolve()
         with self._lock:
+            changed = not _same_keys(keys, self._keys)
             self._keys = keys
+            if changed:
+                self._sig_gen += 1
+                self._sig_cache = {}
 
     # -- the per-request path ------------------------------------------------
+
+    _SIG_CACHE_MAX = 4096
 
     def _verify_signature(self, token: str) -> dict:
         with self._lock:
             keys = list(self._keys)
+            gen = self._sig_gen
+            cache = self._sig_cache
+        hit = cache.get(token)
+        if hit is not None:
+            return hit  # payload is treated read-only downstream
         last = None
         for key in keys:
             try:
-                return jwtlib.verify_rs256(token, key)
+                payload = jwtlib.verify_rs256(token, key)
             except jwtlib.JWTError as e:
                 last = e
+                continue
+            with self._lock:
+                if gen == self._sig_gen:  # keys unchanged since verify
+                    if len(self._sig_cache) >= self._SIG_CACHE_MAX:
+                        self._sig_cache = {}
+                    self._sig_cache[token] = payload
+            return payload
         raise errors.unauthenticated(f"invalid token: {last}")
 
     def _validate_claims(self, payload: dict) -> None:

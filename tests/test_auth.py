@@ -173,3 +173,69 @@ def test_key_rotation(keypair):
     a._resolver = StaticKeyResolver([pub])
     a.refresh_keys()
     assert a.authorize(f"Bearer {tok}", "/x/Y") == "uss1"
+
+
+def test_signature_cache_hit_and_claims_still_enforced(keypair):
+    """The RS256 signature cache must only skip the RSA math — claims
+    (here: expiry) are validated on every request, so a cached token
+    still gets rejected once it expires."""
+    priv, pub = keypair
+    clock = {"now": NOW}
+    a = Authorizer(
+        StaticKeyResolver([pub]),
+        audiences=["dss.example.com"],
+        now=lambda: clock["now"],
+    )
+    tok = jwtlib.sign_rs256(claims(), priv)
+    assert a.authorize(f"Bearer {tok}", "/x/Y") == "uss1"
+    assert tok in a._sig_cache  # cached after the first verify
+    # cache hit path returns the same payload object
+    assert a.authorize(f"Bearer {tok}", "/x/Y") == "uss1"
+    # expiry is enforced per request even on a cache hit
+    clock["now"] = NOW + 3600
+    assert _auth_code(a, tok) == errors.Code.UNAUTHENTICATED
+
+
+def test_signature_cache_invalidated_on_key_rotation(keypair):
+    """A token cached under old keys must not keep verifying after the
+    keys rotate away from its signer."""
+    priv, pub = keypair
+    a = make_authorizer(pub)
+    tok = jwtlib.sign_rs256(claims(), priv)
+    assert a.authorize(f"Bearer {tok}", "/x/Y") == "uss1"
+    assert tok in a._sig_cache
+    other = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    other_pub = other.public_key().public_bytes(
+        serialization.Encoding.PEM,
+        serialization.PublicFormat.SubjectPublicKeyInfo,
+    )
+    a._resolver = StaticKeyResolver([other_pub])
+    a.refresh_keys()
+    assert a._sig_cache == {}
+    assert _auth_code(a, tok) == errors.Code.UNAUTHENTICATED
+
+
+def test_signature_cache_bounded_and_skips_failures(keypair):
+    """Only successful verifies are cached; the cap resets the dict."""
+    priv, pub = keypair
+    a = make_authorizer(pub)
+    bad = jwtlib.sign_rs256(claims(), priv)[:-4] + "AAAA"
+    assert _auth_code(a, bad) == errors.Code.UNAUTHENTICATED
+    assert bad not in a._sig_cache
+    a._SIG_CACHE_MAX = 2  # instance override to exercise the cap
+    for i in range(4):
+        tok = jwtlib.sign_rs256(claims(sub=f"u{i}"), priv)
+        assert a.authorize(f"Bearer {tok}", "/x/Y") == f"u{i}"
+        assert len(a._sig_cache) <= 2
+
+
+def test_signature_cache_survives_no_op_refresh(keypair):
+    """Periodic refreshes that resolve the SAME keys must not flush
+    the cache (deployments poll JWKS every ~60s; tokens live ~1h)."""
+    priv, pub = keypair
+    a = make_authorizer(pub)
+    tok = jwtlib.sign_rs256(claims(), priv)
+    assert a.authorize(f"Bearer {tok}", "/x/Y") == "uss1"
+    assert tok in a._sig_cache
+    a.refresh_keys()  # same resolver, same keys
+    assert tok in a._sig_cache
