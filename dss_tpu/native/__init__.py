@@ -22,21 +22,17 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
-import tempfile
 import threading
 from typing import Optional
 
 import numpy as np
 
+from dss_tpu.native import _buildlib
+
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SOURCES = [
-    os.path.join(_DIR, "covering.cc"),
-    os.path.join(_DIR, "hostquery.cc"),
-    os.path.join(_DIR, "fastwin.cc"),
-]
+_SOURCES = [os.path.join(_DIR, n) for n in _buildlib.SOURCE_NAMES]
 _SRC = _SOURCES[0]  # kept for back-compat references
-_SO = os.path.join(_DIR, "libdsscover.so")
+_SO = os.path.join(_DIR, _buildlib.SO_NAME)
 
 _load_lock = threading.Lock()   # guards _lib / _load_failed + dlopen
 _build_lock = threading.Lock()  # serializes g++ runs (never held with
@@ -47,37 +43,17 @@ _load_failed = False
 
 
 def _build() -> bool:
-    """Compile _SOURCES -> libdsscover.so (atomic rename so racing
-    processes never load a half-written .so)."""
-    tmp = None
-    try:
-        fd, tmp = tempfile.mkstemp(suffix=".so", dir=_DIR)
-        os.close(fd)
-        subprocess.run(
-            ["g++", "-O3", "-shared", "-fPIC", "-o", tmp] + _SOURCES,
-            check=True,
-            capture_output=True,
-            timeout=180,
-        )
-        os.replace(tmp, _SO)
-        return True
-    except Exception:
-        if tmp is not None:
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
-        return False
+    """Compile _SOURCES -> libdsscover.so + digest sidecar (see
+    _buildlib: atomic renames; content-hash freshness)."""
+    return _buildlib.build(_DIR)
 
 
 def _so_fresh() -> bool:
-    if not os.path.exists(_SO):
-        return False
-    so_mtime = os.path.getmtime(_SO)
-    return all(
-        not os.path.exists(src) or so_mtime >= os.path.getmtime(src)
-        for src in _SOURCES
-    )
+    """Content-based: the sidecar digest must match the sources on
+    disk.  mtimes are untrustworthy here — pip stamps installed files
+    with extraction time, so a wheel-shipped stale .so would pass any
+    mtime rule."""
+    return _buildlib.so_fresh(_DIR)
 
 
 def _try_load() -> Optional[ctypes.CDLL]:

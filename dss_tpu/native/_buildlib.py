@@ -1,0 +1,137 @@
+"""Stdlib-only build + freshness logic for libdsscover.so.
+
+Kept free of numpy/jax imports so the Docker image's build stage (a
+bare python:slim with g++) can run it directly:
+
+    python dss_tpu/native/_buildlib.py <dir>
+
+Freshness is CONTENT-based, not mtime-based: a successful build writes
+`libdsscover.so.sha` holding the sha256 of the kernel sources, and the
+loader accepts the .so only when that digest matches the sources on
+disk.  mtimes cannot be trusted here — pip stamps every installed file
+with its extraction time, so a wheel-shipped stale .so would look
+"fresh" under any mtime rule (and whether it did depended on wheel
+entry sort order).  With the digest, a stale shipped .so is detected
+and rebuilt where a toolchain exists, or skipped (numpy fallback)
+where it doesn't.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import subprocess
+import tempfile
+
+# the single source of truth for what goes into the shared library —
+# the Dockerfile build stage and the lazy in-process build both run
+# through build() below, so the list cannot desync
+SOURCE_NAMES = ["covering.cc", "hostquery.cc", "fastwin.cc"]
+SO_NAME = "libdsscover.so"
+DIGEST_NAME = SO_NAME + ".sha"
+
+
+def source_digest(dirpath: str) -> str:
+    """sha256 over the kernel sources, in SOURCE_NAMES order."""
+    h = hashlib.sha256()
+    for name in SOURCE_NAMES:
+        with open(os.path.join(dirpath, name), "rb") as f:
+            h.update(f.read())
+        h.update(b"\x00")  # file boundary
+    return h.hexdigest()
+
+
+_fresh_cache: dict = {}  # dirpath -> (stat signature, verdict)
+
+
+def _stat_sig(dirpath: str):
+    """(name, mtime_ns, size) for the .so, sidecar, and sources — the
+    CACHE key for so_fresh.  Correctness stays content-based; the
+    stats only decide when the digest must be recomputed, so a stale
+    shipped .so on a toolchain-less host costs one hash, not one per
+    request."""
+    out = []
+    for name in [SO_NAME, DIGEST_NAME, *SOURCE_NAMES]:
+        try:
+            st = os.stat(os.path.join(dirpath, name))
+            out.append((name, st.st_mtime_ns, st.st_size))
+        except OSError:
+            out.append((name, None, None))
+    return tuple(out)
+
+
+def so_fresh(dirpath: str) -> bool:
+    """True iff the .so exists and its sidecar digest matches the
+    sources on disk.  Never raises: any unreadable/corrupt state reads
+    as stale (callers fall back to the numpy paths)."""
+    sig = _stat_sig(dirpath)
+    cached = _fresh_cache.get(dirpath)
+    if cached is not None and cached[0] == sig:
+        return cached[1]
+    so = os.path.join(dirpath, SO_NAME)
+    sha = os.path.join(dirpath, DIGEST_NAME)
+    fresh = False
+    if os.path.exists(so) and os.path.exists(sha):
+        try:
+            with open(sha, "r", encoding="ascii") as f:
+                recorded = f.read().strip()
+            fresh = recorded == source_digest(dirpath)
+        except (OSError, UnicodeDecodeError, ValueError):
+            fresh = False
+    _fresh_cache[dirpath] = (sig, fresh)
+    return fresh
+
+
+def build(dirpath: str, timeout: float = 180) -> bool:
+    """Compile the sources -> libdsscover.so + digest sidecar (atomic
+    renames so racing processes never load a half-written pair: the
+    sidecar lands only after the .so it describes)."""
+    tmp = None
+    try:
+        digest = source_digest(dirpath)
+        fd, tmp = tempfile.mkstemp(suffix=".so", dir=dirpath)
+        os.close(fd)
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-o", tmp]
+            + [os.path.join(dirpath, n) for n in SOURCE_NAMES],
+            check=True,
+            capture_output=True,
+            timeout=timeout,
+        )
+        os.replace(tmp, os.path.join(dirpath, SO_NAME))
+        tmp = None
+        fd, tmp = tempfile.mkstemp(suffix=".sha", dir=dirpath)
+        with os.fdopen(fd, "w", encoding="ascii") as f:
+            f.write(digest + "\n")
+        os.replace(tmp, os.path.join(dirpath, DIGEST_NAME))
+        tmp = None
+        _fresh_cache.pop(dirpath, None)
+        return True
+    except Exception as e:
+        # surface compiler diagnostics (the Docker build stage would
+        # otherwise fail with no clue what broke)
+        import sys
+
+        err = getattr(e, "stderr", None)
+        if err:
+            sys.stderr.write(
+                err.decode("utf-8", "replace")
+                if isinstance(err, bytes) else str(err)
+            )
+        if tmp is not None:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+        return False
+
+
+if __name__ == "__main__":
+    import sys
+
+    d = sys.argv[1] if len(sys.argv) > 1 else os.path.dirname(
+        os.path.abspath(__file__)
+    )
+    if not build(d):
+        sys.exit("native kernel build failed")
+    print(f"built {os.path.join(d, SO_NAME)}")

@@ -236,3 +236,56 @@ def test_k8s_manifests_are_structurally_sound():
             if d and d["kind"] == "StatefulSet"
         ][0]
     assert sts["spec"]["volumeClaimTemplates"], "region WAL lost its PVC"
+
+
+def test_dockerfile_ships_native_kernels():
+    """The runtime image is toolchain-less (python:slim), so the
+    Dockerfile must compile libdsscover.so in a build stage and copy
+    it in — otherwise the deployed binary silently serves from the
+    numpy fallbacks (3-26x slower hot paths).  Also pins that
+    packaging ships the kernel sources + prebuilt .so, and that the
+    staged compile covers exactly the sources the lazy in-process
+    builder uses (the two lists must stay in lockstep)."""
+    with open(os.path.join(ROOT, "Dockerfile")) as f:
+        df = f.read()
+    assert "AS native-build" in df
+    # one builder: the stage runs the same stdlib-only _buildlib the
+    # lazy in-process path uses, so the source list cannot desync
+    assert "_buildlib.py" in df
+    assert re.search(
+        r"COPY --from=native-build[\s\S]*libdsscover\.so[\s\S]*"
+        r"libdsscover\.so\.sha", df
+    )
+    with open(os.path.join(ROOT, "pyproject.toml")) as f:
+        py = f.read()
+    assert '"dss_tpu.native" = ["*.cc", "*.so", "*.so.sha"]' in py
+
+
+def test_native_freshness_is_content_based(tmp_path):
+    """The loader must reject a stale .so whose sources changed after
+    it was built, regardless of file mtimes (pip stamps installed
+    files with extraction time, so mtime rules are meaningless in a
+    wheel install)."""
+    import shutil
+
+    from dss_tpu.native import _buildlib
+
+    if shutil.which("g++") is None:
+        pytest.skip("needs a C++ toolchain")
+    d = tmp_path / "native"
+    d.mkdir()
+    src_dir = os.path.join(ROOT, "dss_tpu", "native")
+    for name in _buildlib.SOURCE_NAMES:
+        shutil.copy(os.path.join(src_dir, name), d / name)
+    assert not _buildlib.so_fresh(str(d))  # nothing built yet
+    assert _buildlib.build(str(d))
+    assert _buildlib.so_fresh(str(d))
+    # edit a source: the digest no longer matches -> stale, even
+    # though we ALSO give the .so the newest mtime in the directory
+    with open(d / _buildlib.SOURCE_NAMES[0], "a") as f:
+        f.write("\n// changed\n")
+    os.utime(d / _buildlib.SO_NAME, None)
+    assert not _buildlib.so_fresh(str(d))
+    # rebuild restores freshness
+    assert _buildlib.build(str(d))
+    assert _buildlib.so_fresh(str(d))
