@@ -605,3 +605,95 @@ def _wait_visible(base, isa_id, oauth, version=None):
             return
         assert time.monotonic() < deadline, f"{isa_id} never visible"
         time.sleep(0.05)
+
+
+def test_multiworker_serving_read_your_writes(
+    certs, oauth, tmp_path_factory
+):
+    """--workers N at the binary level (the goroutine-per-RPC scale-out
+    analog, grpc-backend main.go:201-214): the leader owns mutations,
+    SO_REUSEPORT read workers serve searches from a WAL-tail replica
+    and proxy writes.  Pins: (a) a client that keeps its connection
+    sees its own writes immediately (the proxying worker waits for its
+    tail to reach the leader's WAL seq), (b) fresh connections see the
+    write within the bounded-staleness deadline, (c) deletes propagate
+    the same way."""
+    wal = tmp_path_factory.mktemp("workerswal") / "dss.wal"
+    port = free_port()
+    base = f"http://127.0.0.1:{port}"
+    p = Proc(
+        [
+            "dss_tpu.cmds.server",
+            "--addr", f":{port}",
+            "--storage", "memory",
+            "--wal_path", str(wal),
+            "--workers", "2",
+            "--follower_poll_interval", "0.02",
+            "--public_key_files", str(certs / "oauth.pem"),
+            "--accepted_jwt_audiences", AUD,
+        ],
+        "dss-workers",
+    )
+    try:
+        wait_healthy(f"{base}/healthy", p.p, "dss-workers")
+        lat = 48.6
+        h = oauth.hdr(RID_SCOPE)
+
+        # (a) same-connection write -> immediate search must hit,
+        # repeatedly (the kernel spreads fresh connections across the
+        # listeners; a kept session stays on whichever it landed on)
+        for i in range(6):
+            s = requests.Session()
+            isa_id = str(uuid.uuid4())
+            r = s.put(
+                f"{base}/v1/dss/identification_service_areas/{isa_id}",
+                json=isa_params(lat=lat),
+                headers=h,
+                timeout=10,
+            )
+            assert r.status_code == 200, (i, r.text)
+            version = r.json()["service_area"]["version"]
+            r = s.get(
+                f"{base}/v1/dss/identification_service_areas",
+                params={"area": area_str(lat=lat)},
+                headers=h,
+                timeout=10,
+            )
+            assert r.status_code == 200, (i, r.text)
+            found = {a["id"] for a in r.json()["service_areas"]}
+            assert isa_id in found, (
+                f"iteration {i}: read-your-writes violated"
+            )
+            # (c) delete through the same connection, same guarantee
+            r = s.delete(
+                f"{base}/v1/dss/identification_service_areas/"
+                f"{isa_id}/{version}",
+                headers=h,
+                timeout=10,
+            )
+            assert r.status_code == 200, (i, r.text)
+            r = s.get(
+                f"{base}/v1/dss/identification_service_areas",
+                params={"area": area_str(lat=lat)},
+                headers=h,
+                timeout=10,
+            )
+            assert r.status_code == 200, (i, r.text)
+            assert isa_id not in {
+                a["id"] for a in r.json()["service_areas"]
+            }, f"iteration {i}: deleted ISA still served"
+            s.close()
+
+        # (b) fresh connections (no session reuse): bounded staleness
+        isa_id = str(uuid.uuid4())
+        r = requests.put(
+            f"{base}/v1/dss/identification_service_areas/{isa_id}",
+            json=isa_params(lat=lat),
+            headers=h,
+            timeout=10,
+        )
+        assert r.status_code == 200, r.text
+        # requests without a Session open a new connection each call
+        _wait_visible(base, isa_id, oauth)
+    finally:
+        p.stop()
