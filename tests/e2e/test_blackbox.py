@@ -12,6 +12,11 @@ real sockets against real processes (tests/e2e/conftest.py):
   - WAL checkpoint/resume through a real process restart
   - the same two-USS conflict ACROSS two DSS instances of one region
     (test/interoperability/interop_test_suite.py)
+  - region log server SIGKILL + recovery (reads keep serving, failed
+    writes roll back, the region resumes on the same WAL)
+  - --workers multi-process serving with read-your-writes through the
+    SO_REUSEPORT read workers
+  - the --sharded_replica mesh surface
 """
 
 from __future__ import annotations
