@@ -194,3 +194,33 @@ def test_native_wrapper_unavailable_returns_none(monkeypatch):
         np.zeros(1, np.int32), np.zeros(1, np.int32), 2, BLOCK,
         np.zeros(1, np.int32), 1, np.zeros(1, np.uint8),
     ) is None
+
+
+def test_pack_and_decode_parity_at_scale():
+    """One big randomized differential with the sampled two-level
+    index engaged (>2^14 postings), hot cells spanning dozens of
+    blocks, a 2048-query batch, and tombstones — the shapes the
+    serving pipeline actually runs, vs the numpy reference paths."""
+    rng = np.random.default_rng(123)
+    ft, n_cells = _mk_ft(rng, 60_000, 3_000, hot_cells=12)
+    assert ft.n_postings > 1 << 14
+    qb = _mk_queries(rng, 2048, 8, n_cells)
+
+    got_pack = ft._pack_windows(qb[0])
+    want_pack = _numpy_pack(ft, qb[0])
+    _assert_pack_equal(got_pack, want_pack)
+    assert got_pack[3] > 10_000  # the draw actually exercises scale
+
+    # full fused round trip with a tombstone sprinkle mid-stream
+    base_q, base_s = ft.query_fused(*qb, now=NOW)
+    assert len(base_s) > 0
+    for victim in np.unique(base_s)[:50]:
+        ft.slot_exact["live"][int(victim)] = False
+    got = ft.query_fused(*qb, now=NOW)
+    fastpath._NATIVE = (None,)
+    try:
+        want = ft.query_fused(*qb, now=NOW)
+    finally:
+        fastpath._NATIVE = None
+    np.testing.assert_array_equal(got[0], want[0])
+    np.testing.assert_array_equal(got[1], want[1])
