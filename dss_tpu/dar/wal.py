@@ -58,14 +58,22 @@ class WriteAheadLog:
         self._fh = None
         if path is not None:
             os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-            fresh = (
-                not os.path.exists(path) or os.path.getsize(path) == 0
-            )
-            if not fresh:
-                # recover the sequence number from an existing log;
-                # replay() itself gates on the head format record
-                for rec in self.replay():
-                    self._seq = max(self._seq, rec.get("seq", 0))
+            if os.path.exists(path) and os.path.getsize(path) > 0:
+                # one recovery pass: format gate + seq recovery + the
+                # valid-prefix length.  A crash can leave a torn final
+                # line; appending after it would MERGE the next record
+                # into one garbage line that a later replay drops
+                # (silent loss of that write and everything after it),
+                # so truncate to the last complete record first.
+                valid, self._seq = self._recover(path)
+                if valid < os.path.getsize(path):
+                    with open(path, "r+b") as fh:
+                        fh.truncate(valid)
+            # re-stat AFTER truncation: a fully-torn header line must
+            # count as a fresh log and get a fresh format header
+            fresh = os.path.getsize(path) == 0 if os.path.exists(
+                path
+            ) else True
             self._fh = open(path, "a", encoding="utf-8")
             if fresh:
                 # header carries no seq: user records stay 1-based
@@ -74,6 +82,36 @@ class WriteAheadLog:
                     + "\n"
                 )
                 self._fh.flush()
+
+    @staticmethod
+    def _recover(path: str) -> tuple:
+        """-> (valid prefix bytes, max seq) in ONE pass, mirroring
+        replay()'s tolerance exactly (blank lines pass; the first
+        undecodable or newline-less line ends the prefix).  Applies
+        the head format gate — an unsupported log version raises
+        LogFormatError here, refusing boot."""
+        valid = 0
+        seq = 0
+        first = True
+        with open(path, "rb") as fh:
+            while True:
+                line = fh.readline()
+                if not line:
+                    break
+                if not line.endswith(b"\n"):
+                    break  # torn tail (no newline): not complete
+                stripped = line.strip()
+                if stripped:
+                    try:
+                        rec = json.loads(stripped)
+                    except json.JSONDecodeError:
+                        break
+                    if first:
+                        first = False
+                        check_format_record(rec, path)
+                    seq = max(seq, rec.get("seq", 0))
+                valid = fh.tell()
+        return valid, seq
 
     @property
     def seq(self) -> int:
