@@ -122,7 +122,11 @@ class _RegionTail:
         return self.caught_up
 
     def poll(self) -> List[dict]:
-        from dss_tpu.region.client import RegionError, SnapshotRequired
+        from dss_tpu.region.client import (
+            EpochChanged,
+            RegionError,
+            SnapshotRequired,
+        )
 
         out = []
         try:
@@ -139,6 +143,35 @@ class _RegionTail:
                     # state wholesale, then resume tailing after it
                     out.append({"t": "__replica_reset__", "state": state})
                     self._applied = idx
+                    continue
+                except EpochChanged:
+                    # the log server rebooted and may have regressed
+                    # (lost unsynced acked entries, or an older WAL
+                    # restored): our incrementally-applied state may
+                    # contain entries the reborn log never will —
+                    # rebuild wholesale from the log's truth instead
+                    # of silently skipping new entries
+                    log.warning(
+                        "replica: region log epoch changed; rebuilding"
+                    )
+                    # fetch the rebuild material FIRST: adopting the
+                    # epoch before a failed get_snapshot would silence
+                    # the regression forever (no dirty flag here — the
+                    # next poll must re-raise EpochChanged until the
+                    # reset actually happens)
+                    snap = self.client.get_snapshot()
+                    self.client.adopt_epoch()
+                    if snap is not None:
+                        idx, state = snap
+                        out.append(
+                            {"t": "__replica_reset__", "state": state}
+                        )
+                        self._applied = idx
+                    else:
+                        out.append(
+                            {"t": "__replica_reset__", "state": {}}
+                        )
+                        self._applied = 0
                     continue
                 for idx, recs in entries:
                     if idx >= self._applied:

@@ -19,6 +19,16 @@ class OptimisticRejected(Exception):
     conflict, live lease, or compacted history) — nothing was logged."""
 
 
+class EpochChanged(RegionError):
+    """The region log server's boot epoch changed since this client
+    last saw it: the log may have regressed (a crash lost unsynced
+    acked entries, or an older WAL was restored), so locally-applied
+    state can no longer be trusted as a prefix of the log.  Handlers
+    must resync from the log's truth (adopt_epoch() + snapshot +
+    tail); broad RegionError handlers that merely retry keep seeing
+    this raised until someone adopts the new epoch."""
+
+
 class SnapshotRequired(RegionError):
     """The requested log range was compacted away; fetch the snapshot
     and resume from its index."""
@@ -43,6 +53,32 @@ class RegionClient:
         self._session = requests.Session()
         if auth_token:
             self._session.headers["Authorization"] = f"Bearer {auth_token}"
+        # last ADOPTED server boot epoch vs last SEEN on the wire:
+        # a mismatch raises EpochChanged until a resync site adopts
+        self._epoch: Optional[str] = None
+        self._seen_epoch: Optional[str] = None
+
+    def _check_epoch(self, body: dict) -> None:
+        """Raise EpochChanged when the server's boot epoch moved off
+        the adopted one.  Pre-epoch servers (no field) are tolerated —
+        the mixed-version stance this client takes elsewhere."""
+        ep = body.get("epoch")
+        if ep is None:
+            return
+        self._seen_epoch = str(ep)
+        if self._epoch is None:
+            self._epoch = self._seen_epoch
+        elif self._seen_epoch != self._epoch:
+            raise EpochChanged(
+                f"region log epoch changed ({self._epoch[:8]} -> "
+                f"{self._seen_epoch[:8]}): log may have regressed"
+            )
+
+    def adopt_epoch(self) -> None:
+        """Accept the latest seen epoch — call when (re)building local
+        state from the log's current truth (resync/reset)."""
+        if self._seen_epoch is not None:
+            self._epoch = self._seen_epoch
 
     @staticmethod
     def _json(r) -> dict:
@@ -84,9 +120,18 @@ class RegionClient:
                 raise RegionError(f"region log unreachable: {e}") from e
             if r.status_code == 200:
                 body = self._json(r)
+                token = self._field(body, "token", int, "lease")
+                try:
+                    self._check_epoch(body)
+                except EpochChanged:
+                    # the grant is live on the server: release it so a
+                    # failed post-epoch resync can't stall all writers
+                    # for the lease TTL
+                    self.release_lease(token)
+                    raise
                 head = body.get("head")
                 return (
-                    self._field(body, "token", int, "lease"),
+                    token,
                     None if head is None else int(head),
                 )
             if r.status_code == 401:
@@ -158,6 +203,12 @@ class RegionClient:
                     "expected_head": expected_head,
                     "records": records,
                     "cells": sorted(int(c) for c in cells),
+                    # the epoch our validation basis came from: a
+                    # reborn (possibly regressed) log must refuse the
+                    # append outright — its history may differ below
+                    # expected_head, so the footprint check alone is
+                    # not a sound basis across epochs
+                    "epoch": self._epoch,
                 },
                 timeout=self._timeout,
             )
@@ -172,7 +223,9 @@ class RegionClient:
             raise RegionError(
                 f"optimistic append rejected: {r.status_code} {r.text}"
             )
-        return self._field(self._json(r), "index", int, "append_optimistic")
+        body = self._json(r)
+        self._check_epoch(body)
+        return self._field(body, "index", int, "append_optimistic")
 
     def fetch(
         self, from_index: int
@@ -188,6 +241,7 @@ class RegionClient:
         except requests.RequestException as e:
             raise RegionError(f"region fetch failed: {e}") from e
         body = self._json(r)
+        self._check_epoch(body)
         if r.status_code == 409 and body.get("snapshot_required"):
             raise SnapshotRequired(
                 f"log compacted up to {body.get('snapshot_index')}"
@@ -233,11 +287,16 @@ class RegionClient:
         server rejected it as stale (another instance got there first).
         Pass state_json (pre-serialized) to avoid a second large JSON
         dump when the caller already serialized for size accounting."""
+        ep = json.dumps(self._epoch)  # None -> null (pre-epoch servers)
         if state_json is not None:
-            body = ('{"index":%d,"state":%s}' % (index, state_json)).encode()
+            body = (
+                '{"index":%d,"epoch":%s,"state":%s}'
+                % (index, ep, state_json)
+            ).encode()
         else:
             body = json.dumps(
-                {"index": index, "state": state}, separators=(",", ":")
+                {"index": index, "epoch": self._epoch, "state": state},
+                separators=(",", ":"),
             ).encode()
         try:
             r = self._session.post(

@@ -58,6 +58,7 @@ from typing import List, Optional, Tuple
 
 from dss_tpu import errors
 from dss_tpu.region.client import (
+    EpochChanged,
     OptimisticRejected,
     RegionClient,
     RegionError,
@@ -228,6 +229,16 @@ class RegionCoordinator:
 
             try:
                 token, head = self._client.acquire_lease()
+            except EpochChanged:
+                log.warning(
+                    "region log epoch changed at lease acquire; "
+                    "resyncing before validating this write"
+                )
+                try:
+                    self._resync_locked()
+                    token, head = self._client.acquire_lease()
+                except RegionError as e:  # incl. a second epoch flip
+                    raise errors.unavailable(f"region write lease: {e}")
             except RegionError as e:
                 raise errors.unavailable(f"region write lease: {e}")
             released = False
@@ -457,6 +468,17 @@ class RegionCoordinator:
                     )
                 self._restore_snapshot_locked(*snap)
                 continue
+            except EpochChanged:
+                # the log server rebooted (possibly having regressed):
+                # writes must not validate against diverged local
+                # state — adopt the log's truth, then finish catching
+                # up against the new epoch
+                log.warning(
+                    "region log epoch changed during catch-up; "
+                    "resyncing"
+                )
+                self._resync_locked()
+                continue
             for idx, recs in entries:
                 if idx >= self._applied:
                     self._apply_entry_locked(recs)
@@ -471,6 +493,9 @@ class RegionCoordinator:
         consistent; writes refuse while dirty)."""
         self._resyncs += 1
         log.warning("region resync: fetching snapshot + log tail")
+        # resync rebuilds from the log's CURRENT truth: accept its
+        # epoch so the fetches below don't re-raise EpochChanged
+        self._client.adopt_epoch()
         snap = None
         start = 0
         try:
@@ -514,6 +539,10 @@ class RegionCoordinator:
             self._dirty = True
             raise
         self._dirty = False
+        # a regressed log can leave the old (higher) snapshot mark in
+        # place, which would suppress snapshot uploads — and therefore
+        # log compaction — until _applied re-passed it
+        self._last_snapshot = min(self._last_snapshot, self._applied)
 
     def _resync_or_mark_dirty(self) -> None:
         try:
@@ -541,6 +570,21 @@ class RegionCoordinator:
                     entries, _head = self._client.fetch(self._applied)
                 except SnapshotRequired:
                     # we fell behind compaction: full snapshot restore
+                    with self._lock:
+                        self._resync_locked()
+                    continue
+                except EpochChanged:
+                    # the log server rebooted — it may have REGRESSED
+                    # (lost acked-but-unsynced entries in a crash, or
+                    # an operator restored an older WAL).  Index
+                    # comparisons can miss this once new writes push
+                    # the head back past our cursor, so the epoch
+                    # nonce is the detection mechanism: adopt the
+                    # log's truth via resync.
+                    log.warning(
+                        "region log epoch changed; resyncing to the "
+                        "log's state"
+                    )
                     with self._lock:
                         self._resync_locked()
                     continue

@@ -60,6 +60,16 @@ MAX_LEASE_TTL_S = 60.0
 
 class RegionLog:
     def __init__(self, wal_path: Optional[str] = None):
+        # boot epoch: a fresh nonce per server start, carried on every
+        # response.  Instances detect a changed epoch and resync to
+        # the log's truth — the robust guard against a log that
+        # regressed across a restart (lost unsynced acked entries, or
+        # an operator-restored older WAL), where index comparisons
+        # alone have false-negative windows once new writes push the
+        # head back past a stale reader's cursor.
+        import uuid as _uuid
+
+        self.epoch = _uuid.uuid4().hex
         self._wal = WriteAheadLog(wal_path)
         self._base = 0  # index of _entries[0] (entries below are compacted)
         self._entries: List[List[dict]] = []
@@ -344,7 +354,9 @@ def build_region_app(
             )
         # head rides along so a writer that is already current can skip
         # its catch-up fetch (one fewer round trip per write)
-        return web.json_response({"token": token, "head": log.head})
+        return web.json_response(
+            {"token": token, "head": log.head, "epoch": log.epoch}
+        )
 
     async def lease_release(request):
         try:
@@ -371,7 +383,9 @@ def build_region_app(
             # ack lets a new client detect an old server that ignored
             # the flag (and fall back to an explicit release)
             log.release(token)
-        return web.json_response({"index": idx, "released": release})
+        return web.json_response(
+            {"index": idx, "released": release, "epoch": log.epoch}
+        )
 
     async def append_optimistic(request):
         try:
@@ -385,13 +399,24 @@ def build_region_app(
             return web.json_response(
                 {"error": "expected_head required"}, status=400
             )
+        client_epoch = body.get("epoch")
+        if client_epoch is not None and client_epoch != log.epoch:
+            # the writer validated against a previous boot's log,
+            # whose history below expected_head may differ from ours:
+            # refuse BEFORE anything lands; the lease-path retry's
+            # epoch check forces the writer to resync + revalidate
+            return web.json_response(
+                {"error": "epoch", "reason": "epoch", "head": log.head,
+                 "epoch": log.epoch},
+                status=409,
+            )
         status, idx = log.append_optimistic(expected_head, records, cells)
         if status != "ok":
             return web.json_response(
                 {"error": status, "reason": status, "head": log.head},
                 status=409,
             )
-        return web.json_response({"index": idx})
+        return web.json_response({"index": idx, "epoch": log.epoch})
 
     async def records(request):
         try:
@@ -407,10 +432,13 @@ def build_region_app(
                 {
                     "snapshot_required": True,
                     "snapshot_index": log.snapshot_index,
+                    "epoch": log.epoch,
                 },
                 status=409,
             )
-        return web.json_response({"entries": entries, "head": log.head})
+        return web.json_response(
+            {"entries": entries, "head": log.head, "epoch": log.epoch}
+        )
 
     async def snapshot_put(request):
         try:
@@ -419,6 +447,15 @@ def build_region_app(
             state = body["state"]
         except (ValueError, TypeError, KeyError, AttributeError):
             return web.json_response({"error": "malformed body"}, status=400)
+        client_epoch = body.get("epoch")
+        if client_epoch is not None and client_epoch != log.epoch:
+            # a stale-epoch instance's state may contain entries this
+            # (reborn) log lost: accepting it as the authoritative
+            # snapshot would compact the CORRECT entries away and
+            # poison every future resync/late-join
+            return web.json_response(
+                {"error": "epoch", "epoch": log.epoch}, status=409
+            )
         # Two-phase durable compaction: the bulk write + fsync runs in
         # a worker thread (the loop keeps serving /lease and /append —
         # a stalled loop would expire writers' leases); the small

@@ -14,6 +14,7 @@ real region log server over HTTP on localhost (the DCN stand-in).
 from __future__ import annotations
 
 import asyncio
+import os
 import threading
 import time
 import uuid
@@ -45,11 +46,12 @@ VISIBILITY_DEADLINE_S = 15.0
 class RegionServerThread:
     """Run the region log app on a background event loop; real sockets."""
 
-    def __init__(self, wal_path=None, auth_token=None):
+    def __init__(self, wal_path=None, auth_token=None, port=0):
         self._loop = asyncio.new_event_loop()
         self._app = build_region_app(wal_path, auth_token=auth_token)
         self._started = threading.Event()
         self.port = None
+        self._want_port = port  # 0 = ephemeral; fixed for restarts
         self._runner = None
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
@@ -63,7 +65,10 @@ class RegionServerThread:
         asyncio.set_event_loop(self._loop)
         self._runner = web.AppRunner(self._app)
         self._loop.run_until_complete(self._runner.setup())
-        site = web.TCPSite(self._runner, "127.0.0.1", 0)
+        site = web.TCPSite(
+            self._runner, "127.0.0.1", self._want_port,
+            reuse_address=True,
+        )
         self._loop.run_until_complete(site.start())
         self.port = site._server.sockets[0].getsockname()[1]
         self._started.set()
@@ -913,3 +918,63 @@ def test_optimistic_ambiguous_failure_converges(region):
             assert time.monotonic() < deadline
             time.sleep(0.05)
     assert got["service_area"]["id"] == isa_id
+
+
+def test_log_regression_triggers_resync(tmp_path):
+    """The log server crashes having lost acked-but-unsynced entries
+    (fsync off is the documented group-commit tradeoff) — or an
+    operator restores an older WAL.  Instances whose applied index is
+    now AHEAD of the log head must detect the regression and resync to
+    the log's truth (dropping the lost writes) instead of silently
+    skipping every new entry until the head re-crosses their stale
+    cursor."""
+    wal = str(tmp_path / "region.wal")
+    server = RegionServerThread(wal_path=wal)
+    port = server.port
+    a = make_instance(server.url, "reg-a")
+    b = make_instance(server.url, "reg-b")
+    try:
+        svc_a = RIDService(a.rid, a.clock)
+        svc_b = RIDService(b.rid, b.clock)
+        isa1, isa2 = str(uuid.uuid4()), str(uuid.uuid4())
+        svc_a.create_isa(
+            isa1,
+            {"extents": rid_extents(), "flights_url": "https://u.e/1"},
+            "uss1",
+        )
+        wait_until(lambda: b.rid.get_isa(isa1))
+        keep_bytes = os.path.getsize(wal)
+        svc_a.create_isa(
+            isa2,
+            {"extents": rid_extents(lat=37.2), "flights_url": "https://u.e/2"},
+            "uss1",
+        )
+        wait_until(lambda: b.rid.get_isa(isa2))
+
+        # crash the log server and lose isa2's entry (torn/unsynced)
+        server.stop()
+        with open(wal, "r+b") as f:
+            f.truncate(keep_bytes)
+        server = RegionServerThread(wal_path=wal, port=port)
+
+        # both instances adopt the log's truth: isa2 vanishes
+        for store in (a, b):
+            wait_until(
+                lambda s=store: True
+                if s.rid.get_isa(isa2) is None else None
+            )
+            assert store.rid.get_isa(isa1) is not None
+            # the mechanism is an epoch-triggered resync, not luck
+            assert store.stats().get("region_resyncs", 0) >= 1
+        # and the region keeps working end to end afterwards
+        isa3 = str(uuid.uuid4())
+        svc_b.create_isa(
+            isa3,
+            {"extents": rid_extents(lat=37.4), "flights_url": "https://u.e/3"},
+            "uss2",
+        )
+        wait_until(lambda: a.rid.get_isa(isa3))
+    finally:
+        a.close()
+        b.close()
+        server.stop()
