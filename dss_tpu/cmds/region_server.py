@@ -27,6 +27,14 @@ def make_parser() -> argparse.ArgumentParser:
         "in-memory (testing only)",
     )
     p.add_argument(
+        "--wal_fsync",
+        action="store_true",
+        help="fsync every append before acking: an acked write then "
+        "survives a host crash, at per-append fsync cost.  Without it "
+        "a crash can lose the unsynced tail — instances detect the "
+        "regression via the boot epoch and resync to the log's truth",
+    )
+    p.add_argument(
         "--token_file",
         default="",
         help="file holding the shared region secret; every instance "
@@ -42,7 +50,9 @@ def build(args) -> web.Application:
         with open(args.token_file, "r", encoding="utf-8") as fh:
             token = fh.read().strip()
     return build_region_app(
-        args.wal_path or None, auth_token=token or None
+        args.wal_path or None,
+        auth_token=token or None,
+        fsync=args.wal_fsync,
     )
 
 

@@ -168,6 +168,11 @@ class RegionClient:
                     "token": token,
                     "records": records,
                     "release": release,
+                    # epoch the lease was granted under: a reborn
+                    # server resets its lease counter, so an integer
+                    # token can collide across epochs — the server
+                    # refuses a mismatched epoch before anything lands
+                    "epoch": self._epoch,
                 },
                 timeout=self._timeout,
             )

@@ -434,8 +434,14 @@ class RegionCoordinator:
         finally:
             with self._lock:
                 # advance even on failure: back off instead of
-                # re-serializing on every poll tick
-                self._last_snapshot = max(self._last_snapshot, idx)
+                # re-serializing on every poll tick — but never past
+                # the CURRENT applied index: a concurrent epoch resync
+                # may have rewound it, and a mark above it would
+                # suppress snapshots (and compaction) until applied
+                # re-passed the stale value
+                self._last_snapshot = max(
+                    self._last_snapshot, min(idx, self._applied)
+                )
 
     # -- apply / resync (store lock held) ------------------------------------
 
@@ -568,23 +574,20 @@ class RegionCoordinator:
                 # the lock drops anything applied concurrently
                 try:
                     entries, _head = self._client.fetch(self._applied)
-                except SnapshotRequired:
-                    # we fell behind compaction: full snapshot restore
-                    with self._lock:
-                        self._resync_locked()
-                    continue
-                except EpochChanged:
-                    # the log server rebooted — it may have REGRESSED
-                    # (lost acked-but-unsynced entries in a crash, or
-                    # an operator restored an older WAL).  Index
-                    # comparisons can miss this once new writes push
-                    # the head back past our cursor, so the epoch
-                    # nonce is the detection mechanism: adopt the
-                    # log's truth via resync.
-                    log.warning(
-                        "region log epoch changed; resyncing to the "
-                        "log's state"
-                    )
+                except (SnapshotRequired, EpochChanged) as e:
+                    # behind compaction -> snapshot restore; OR the
+                    # log server rebooted and may have REGRESSED (lost
+                    # acked-but-unsynced entries in a crash, or an
+                    # operator restored an older WAL) — the epoch
+                    # nonce is the detection mechanism, since index
+                    # comparisons can miss a regression once new
+                    # writes push the head back past our cursor.
+                    # Either way: adopt the log's truth via resync.
+                    if isinstance(e, EpochChanged):
+                        log.warning(
+                            "region log epoch changed; resyncing to "
+                            "the log's state"
+                        )
                     with self._lock:
                         self._resync_locked()
                     continue

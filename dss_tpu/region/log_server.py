@@ -59,7 +59,9 @@ MAX_LEASE_TTL_S = 60.0
 
 
 class RegionLog:
-    def __init__(self, wal_path: Optional[str] = None):
+    def __init__(
+        self, wal_path: Optional[str] = None, *, fsync: bool = False
+    ):
         # boot epoch: a fresh nonce per server start, carried on every
         # response.  Instances detect a changed epoch and resync to
         # the log's truth — the robust guard against a log that
@@ -70,7 +72,7 @@ class RegionLog:
         import uuid as _uuid
 
         self.epoch = _uuid.uuid4().hex
-        self._wal = WriteAheadLog(wal_path)
+        self._wal = WriteAheadLog(wal_path, fsync=fsync)
         self._base = 0  # index of _entries[0] (entries below are compacted)
         self._entries: List[List[dict]] = []
         # per-entry cell footprint (frozenset of ints) or None
@@ -310,9 +312,12 @@ class RegionLog:
 
 
 def build_region_app(
-    wal_path: Optional[str] = None, *, auth_token: Optional[str] = None
+    wal_path: Optional[str] = None,
+    *,
+    auth_token: Optional[str] = None,
+    fsync: bool = False,
 ) -> web.Application:
-    log = RegionLog(wal_path)
+    log = RegionLog(wal_path, fsync=fsync)
     app = web.Application(client_max_size=256 * 1024 * 1024)
     app["region_log"] = log
     # serializes concurrent snapshot_put compactions (appends never
@@ -375,6 +380,15 @@ def build_region_app(
             release = bool(body.get("release", False))
         except (ValueError, TypeError, AttributeError):
             return web.json_response({"error": "malformed body"}, status=400)
+        client_epoch = body.get("epoch")
+        if client_epoch is not None and client_epoch != log.epoch:
+            # the lease token was granted by a previous boot: integer
+            # tokens can collide across epochs (the counter resets),
+            # and the writer's validation basis may predate a
+            # regression — fence it like a stale token
+            return web.json_response(
+                {"error": "epoch fenced", "epoch": log.epoch}, status=409
+            )
         idx = log.append(token, records)
         if idx is None:
             return web.json_response({"error": "lease fenced"}, status=409)
