@@ -978,3 +978,51 @@ def test_log_regression_triggers_resync(tmp_path):
         a.close()
         b.close()
         server.stop()
+
+
+def test_epoch_wire_contract(tmp_path):
+    """The epoch fence at the client/server seam: a client that tailed
+    epoch A must (a) raise EpochChanged on the first fetch against a
+    reborn server, (b) keep raising until adopt_epoch, (c) have its
+    stale-epoch optimistic appends and lease appends refused
+    server-side BEFORE anything lands."""
+    from dss_tpu.region.client import (
+        EpochChanged,
+        OptimisticRejected,
+        RegionClient,
+    )
+
+    wal = str(tmp_path / "region.wal")
+    server = RegionServerThread(wal_path=wal)
+    port = server.port
+    c = RegionClient(server.url, "epoch-test")
+    token, _head = c.acquire_lease()
+    assert c.append(token, [{"t": "x"}], release=True) == 0
+    entries, head = c.fetch(0)
+    assert head == 1 and len(entries) == 1
+
+    # reborn server, same WAL, same port -> new epoch
+    server.stop()
+    server = RegionServerThread(wal_path=wal, port=port)
+    try:
+        with pytest.raises(EpochChanged):
+            c.fetch(0)
+        with pytest.raises(EpochChanged):  # keeps raising until adopted
+            c.fetch(0)
+        # stale-epoch optimistic append: refused server-side (409 ->
+        # OptimisticRejected), nothing lands
+        with pytest.raises(OptimisticRejected):
+            c.append_optimistic(1, [{"t": "y"}], cells=[1, 2])
+        # stale-epoch lease append: fenced even if an integer token
+        # collides across the reboot
+        t2, _ = RegionClient(server.url, "other").acquire_lease()
+        with pytest.raises(RegionError):
+            c.append(t2, [{"t": "z"}])
+        _, head = RegionClient(server.url, "check").fetch(0)
+        assert head == 1  # nothing landed from the stale client
+        # adoption restores service
+        c.adopt_epoch()
+        entries, head = c.fetch(0)
+        assert head == 1 and entries[0][1][0]["t"] == "x"
+    finally:
+        server.stop()
