@@ -69,7 +69,7 @@ def _norm_outcome(fn, *args):
         return ("err", e.http_status, int(e.code))
 
 
-@pytest.mark.parametrize("seed", [1, 2, 3])
+@pytest.mark.parametrize("seed", list(range(1, 9)))
 def test_backends_agree_under_random_ops(seed):
     stores = {
         name: DSSStore(storage=name) for name in ("memory", "tpu")
@@ -85,8 +85,10 @@ def test_backends_agree_under_random_ops(seed):
     # timestamps), so each backend presents its OWN keys
     op_ovns: dict = {n: {} for n in stores}
 
-    for step in range(60):
-        op = rng.integers(0, 6)
+    rid_sub_versions: dict = {n: {} for n in stores}
+
+    for step in range(90):
+        op = rng.integers(0, 9)
         sid = str(uuid.UUID(int=int(rng.integers(0, 40)), version=4))
         if op == 0:  # ISA create (fresh id, same for both backends)
             create_id = (
@@ -162,6 +164,41 @@ def test_backends_agree_under_random_ops(seed):
                 n: _norm_outcome(scd[n].delete_operation, sid, "u1")
                 for n in stores
             }
+        elif op == 6:  # RID subscription create/upsert (quota DSS0050)
+            body = {
+                "extents": _extents(rng),
+                "callbacks": {
+                    "identification_service_area_url": "https://u/i"
+                },
+            }
+            outs = {
+                n: _norm_outcome(
+                    rid[n].create_subscription, sid, body, "u1"
+                )
+                for n in stores
+            }
+        elif op == 7:  # RID subscription delete (maybe-stale version)
+            outs = {
+                n: _norm_outcome(
+                    rid[n].delete_subscription,
+                    sid,
+                    rid_sub_versions[n].get(sid, "aaaaaaaaaa"),
+                    "u1",
+                )
+                for n in stores
+            }
+        elif op == 8:  # ISA update with the CURRENT version (fencing)
+            body = {"extents": _extents(rng), "flights_url": "https://u/f"}
+            outs = {
+                n: _norm_outcome(
+                    rid[n].update_isa,
+                    sid,
+                    isa_versions[n].get(sid, "aaaaaaaaaa"),
+                    body,
+                    "u1",
+                )
+                for n in stores
+            }
         else:  # SCD search
             ext = _extents(rng)  # ONE draw: coherent volume + window
             aoi = {
@@ -228,6 +265,28 @@ def test_backends_agree_under_random_ops(seed):
         elif op == 4:
             for m in op_ovns.values():
                 m.pop(sid, None)
+        elif op == 6:
+            rid_sub_versions["memory"][sid] = a["subscription"]["version"]
+            rid_sub_versions["tpu"][sid] = b["subscription"]["version"]
+            # affected ISAs returned on sub create must agree
+            ids_a = sorted(x["id"] for x in a.get("service_areas", []))
+            ids_b = sorted(x["id"] for x in b.get("service_areas", []))
+            assert ids_a == ids_b, (step, ids_a, ids_b)
+        elif op == 7:
+            for m in rid_sub_versions.values():
+                m.pop(sid, None)
+        elif op == 8:
+            subs_a = sorted(
+                x["subscriptions"][0]["subscription_id"]
+                for x in a["subscribers"]
+            )
+            subs_b = sorted(
+                x["subscriptions"][0]["subscription_id"]
+                for x in b["subscribers"]
+            )
+            assert subs_a == subs_b, (step, subs_a, subs_b)
+            isa_versions["memory"][sid] = a["service_area"]["version"]
+            isa_versions["tpu"][sid] = b["service_area"]["version"]
 
     for s in stores.values():
         s.close()
