@@ -171,9 +171,21 @@ def test_backends_agree_under_random_ops(seed):
                     "identification_service_area_url": "https://u/i"
                 },
             }
+            # upsert: create when unseen, version-fenced update after
+            # (each backend presents its OWN version token)
             outs = {
-                n: _norm_outcome(
-                    rid[n].create_subscription, sid, body, "u1"
+                n: (
+                    _norm_outcome(
+                        rid[n].update_subscription,
+                        sid,
+                        rid_sub_versions[n][sid],
+                        body,
+                        "u1",
+                    )
+                    if sid in rid_sub_versions[n]
+                    else _norm_outcome(
+                        rid[n].create_subscription, sid, body, "u1"
+                    )
                 )
                 for n in stores
             }
