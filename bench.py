@@ -46,6 +46,7 @@ import time
 import numpy as np
 
 import dss_tpu.ops.conflict as C  # noqa: F401  (enables x64 before jax init)
+from dss_tpu import errors
 from dss_tpu.dar.coalesce import QueryCoalescer
 from dss_tpu.dar.oracle import Record
 from dss_tpu.dar.snapshot import DarTable
@@ -277,14 +278,44 @@ def dispatch_floor_ms() -> float:
     return sorted(ts)[len(ts) // 2] * 1000
 
 
+def _stage_breakdown(st0: dict, st1: dict) -> dict:
+    """Per-stage pipeline report from two QueryCoalescer.stats()
+    snapshots: avg pack/device/collect ms per batch over the window,
+    plus batching/shed counters — the direct view of the tentpole
+    (pack of batch N+1 overlapping device+collect of batch N)."""
+    batches = st1["co_batches"] - st0["co_batches"]
+    d = max(1, batches)
+    return {
+        "batches": batches,
+        "batched_items": st1["co_items"] - st0["co_items"],
+        "inline": st1["co_inline"] - st0["co_inline"],
+        "shed": st1["co_shed"] - st0["co_shed"],
+        "pack_ms_avg": round(
+            (st1["co_pack_ms_total"] - st0["co_pack_ms_total"]) / d, 3
+        ),
+        "device_ms_avg": round(
+            (st1["co_device_ms_total"] - st0["co_device_ms_total"]) / d, 3
+        ),
+        "collect_ms_avg": round(
+            (st1["co_collect_ms_total"] - st0["co_collect_ms_total"]) / d, 3
+        ),
+        "batch_size_end": st1["co_batch_size"],
+        "batch_grows": st1["co_batch_grows"] - st0["co_batch_grows"],
+        "batch_shrinks": st1["co_batch_shrinks"] - st0["co_batch_shrinks"],
+    }
+
+
 def serving_leg(table, n_cells, width, threads, warm_s, run_s):
     """Closed-loop clients through the QueryCoalescer: the full
     serving read path (query_many: fused kernel + overlay scan +
-    dead-slot filter + id assembly), continuous micro-batching."""
+    dead-slot filter + id assembly), pipelined continuous
+    micro-batching with per-stage (pack/device/collect) timings."""
     co = QueryCoalescer(table)
     stop = threading.Event()
     warm_until = time.perf_counter() + warm_s
     lats: list = [[] for _ in range(threads)]
+    sheds = [0] * threads
+    st_warm = {}
 
     def client(i):
         r = np.random.default_rng(1000 + i)
@@ -294,7 +325,14 @@ def serving_leg(table, n_cells, width, threads, warm_s, run_s):
             alo = float(r.uniform(0, 3000))
             t0 = NOW + int(r.integers(-2, 2)) * HOUR
             t_req = time.perf_counter()
-            co.query(keys, alo, alo + 300.0, t0, t0 + HOUR, now=NOW)
+            try:
+                co.query(keys, alo, alo + 300.0, t0, t0 + HOUR, now=NOW)
+            except errors.OverloadedError:
+                # closed-loop clients self-throttle, so sheds are rare;
+                # count them rather than crash the client thread
+                if t_req >= warm_until:
+                    sheds[i] += 1
+                continue
             t_done = time.perf_counter()
             if t_done >= warm_until:
                 lats[i].append(t_done - t_req)
@@ -302,10 +340,13 @@ def serving_leg(table, n_cells, width, threads, warm_s, run_s):
     ths = [threading.Thread(target=client, args=(i,)) for i in range(threads)]
     for t in ths:
         t.start()
-    time.sleep(warm_s + run_s)
+    time.sleep(warm_s)
+    st_warm = co.stats()  # stage accounting for the measured window only
+    time.sleep(run_s)
     stop.set()
     for t in ths:
         t.join()
+    st_end = co.stats()
     co.close()
     all_lats = np.sort(np.concatenate([np.asarray(l) for l in lats]))
     if len(all_lats) == 0:
@@ -316,7 +357,9 @@ def serving_leg(table, n_cells, width, threads, warm_s, run_s):
         "p99_ms": float(all_lats[int(len(all_lats) * 0.99)] * 1000),
         "threads": threads,
         "samples": int(len(all_lats)),
+        "shed": int(sum(sheds)),
         "host_cpus": os.cpu_count(),
+        "stages": _stage_breakdown(st_warm, st_end),
     }
 
 
@@ -334,6 +377,7 @@ def curve_leg(table, n_cells, width, rates, secs, warm_s=1.0):
         stop_at = time.perf_counter() + warm_s + secs
         warm_until = time.perf_counter() + warm_s
         lats: list = [[] for _ in range(k)]
+        sheds = [0] * k
 
         def client(i):
             r = np.random.default_rng(5000 + i)
@@ -350,7 +394,17 @@ def curve_leg(table, n_cells, width, rates, secs, warm_s=1.0):
                 keys = (start + np.arange(width)).astype(np.int32)
                 alo = float(r.uniform(0, 3000))
                 t0 = NOW + int(r.integers(-2, 2)) * HOUR
-                co.query(keys, alo, alo + 300.0, t0, t0 + HOUR, now=NOW)
+                try:
+                    co.query(
+                        keys, alo, alo + 300.0, t0, t0 + HOUR, now=NOW
+                    )
+                except errors.OverloadedError:
+                    # backpressure shed: admitted requests keep bounded
+                    # latency, this one is counted against the curve
+                    if time.perf_counter() >= warm_until:
+                        sheds[i] += 1
+                    next_t += interval
+                    continue
                 done = time.perf_counter()
                 if done >= warm_until:
                     # latency from the scheduled send time: queueing
@@ -364,12 +418,19 @@ def curve_leg(table, n_cells, width, rates, secs, warm_s=1.0):
         t_run0 = time.perf_counter()
         for t in ths:
             t.start()
+        # stage accounting for the measured window only, matching the
+        # warm_until filter on latencies/sheds (first-batch jit compile
+        # and warm-up shrinks would otherwise skew the averages)
+        time.sleep(max(0.0, warm_until - time.perf_counter()))
+        st0 = co.stats()
         for t in ths:
             t.join()
         span = time.perf_counter() - t_run0 - warm_s
+        st1 = co.stats()
         all_l = np.sort(np.concatenate([np.asarray(x) for x in lats]))
         if len(all_l) == 0:
             continue
+        n_shed = int(sum(sheds))
         row = {
             "offered_qps": offered,
             "achieved_qps": round(len(all_l) / max(span, 1e-9), 1),
@@ -378,6 +439,11 @@ def curve_leg(table, n_cells, width, rates, secs, warm_s=1.0):
                 float(all_l[int(len(all_l) * 0.99)]) * 1000, 2
             ),
             "threads": k,
+            "shed": n_shed,
+            "shed_rate": round(
+                n_shed / max(1, n_shed + len(all_l)), 4
+            ),
+            "stages": _stage_breakdown(st0, st1),
         }
         rows.append(row)
         if row["p50_ms"] > 50 or row["achieved_qps"] < offered * 0.5:

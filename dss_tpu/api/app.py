@@ -23,6 +23,7 @@ from __future__ import annotations
 import asyncio
 import functools
 import json
+import math
 import time
 from typing import Optional
 
@@ -86,9 +87,16 @@ def _error_response(e: errors.StatusError) -> web.Response:
         # (cmds/http-gateway/main.go:187-200)
         body = e.details or {"message": e.message}
         return web.json_response(body, status=e.http_status)
+    headers = None
+    retry_after = getattr(e, "retry_after_s", None)
+    if retry_after is not None:
+        # overload shed (429): tell the client when the queue should
+        # have drained; well-behaved USS clients back off accordingly
+        headers = {"Retry-After": str(max(1, math.ceil(retry_after)))}
     return web.json_response(
         {"error": e.message, "message": e.message, "code": int(e.code)},
         status=e.http_status,
+        headers=headers,
     )
 
 
@@ -145,17 +153,25 @@ def make_timeout_middleware(timeout_s: float):
     SQL round trip is in flight); /healthy is exempt so orchestration
     probes never queue behind a wedged store."""
 
+    # asyncio.timeout cancels in-place (no extra task per request,
+    # unlike wait_for); async_timeout is the same shape for
+    # Python < 3.11.  Resolved once here so a missing async_timeout
+    # wheel fails at startup, not per-request at serve time.
+    timeout_ctx = getattr(asyncio, "timeout", None)
+    if timeout_ctx is None:
+        import async_timeout
+
+        timeout_ctx = async_timeout.timeout
+
     @web.middleware
     async def timeout_middleware(request, handler):
         # /debug/profile deliberately runs longer than any deadline
         if request.path in ("/healthy", "/debug/profile"):
             return await handler(request)
         try:
-            # asyncio.timeout cancels in-place (no extra task per
-            # request, unlike wait_for)
-            async with asyncio.timeout(timeout_s):
+            async with timeout_ctx(timeout_s):
                 return await handler(request)
-        except TimeoutError:
+        except (TimeoutError, asyncio.TimeoutError):
             return _error_response(
                 errors.deadline_exceeded(
                     f"request exceeded the {timeout_s:g}s deadline"

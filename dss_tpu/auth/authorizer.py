@@ -243,7 +243,14 @@ class Authorizer:
             with self._lock:
                 if gen == self._sig_gen:  # keys unchanged since verify
                     if len(self._sig_cache) >= self._SIG_CACHE_MAX:
-                        self._sig_cache = {}
+                        # evict the oldest ~25% (dict preserves
+                        # insertion order) instead of flushing: a
+                        # wholesale clear made every live token in the
+                        # fleet re-pay the ~40us RSA verify at once — a
+                        # periodic re-verification stampede at the cap
+                        drop = max(1, self._SIG_CACHE_MAX // 4)
+                        for k in list(self._sig_cache)[:drop]:
+                            del self._sig_cache[k]
                     self._sig_cache[token] = payload
             return payload
         raise errors.unauthenticated(f"invalid token: {last}")

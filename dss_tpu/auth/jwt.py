@@ -12,13 +12,38 @@ import base64
 import json
 from typing import Optional, Tuple
 
-from cryptography.exceptions import InvalidSignature
-from cryptography.hazmat.primitives import hashes, serialization
-from cryptography.hazmat.primitives.asymmetric import padding, rsa
+# `cryptography` is an optional dependency: token signing/verification
+# needs it, but importing this module (and everything above it — the
+# authorizer, the app builder) must not, so no-auth deployments and
+# environments without the wheel still serve.  Every entry point that
+# actually touches RSA goes through _crypto() and fails as a JWTError.
+try:  # pragma: no cover - exercised implicitly by both environments
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import padding, rsa
+
+    _CRYPTO_ERR = None
+except ImportError as _e:  # noqa: N816
+    InvalidSignature = hashes = serialization = padding = rsa = None
+    _CRYPTO_ERR = _e
 
 
 class JWTError(Exception):
     pass
+
+
+def crypto_available() -> bool:
+    """True when the `cryptography` wheel is importable."""
+    return _CRYPTO_ERR is None
+
+
+def _crypto() -> None:
+    """Raise JWTError when RSA primitives are unavailable."""
+    if _CRYPTO_ERR is not None:
+        raise JWTError(
+            f"cryptography is not installed ({_CRYPTO_ERR}); "
+            "RS256 sign/verify is unavailable"
+        )
 
 
 def _b64url_encode(data: bytes) -> str:
@@ -33,7 +58,8 @@ def _b64url_decode(s: str) -> bytes:
         raise JWTError(f"bad base64url segment: {e}")
 
 
-def load_private_key(pem: bytes) -> rsa.RSAPrivateKey:
+def load_private_key(pem: bytes) -> "rsa.RSAPrivateKey":
+    _crypto()
     key = serialization.load_pem_private_key(pem, password=None)
     if not isinstance(key, rsa.RSAPrivateKey):
         raise JWTError("private key is not RSA")
@@ -42,6 +68,7 @@ def load_private_key(pem: bytes) -> rsa.RSAPrivateKey:
 
 def load_public_key(pem: bytes):
     """Accept either a public key PEM or a certificate PEM."""
+    _crypto()
     try:
         key = serialization.load_pem_public_key(pem)
     except ValueError:
@@ -54,6 +81,7 @@ def load_public_key(pem: bytes):
 
 
 def sign_rs256(claims: dict, private_key, kid: Optional[str] = None) -> str:
+    _crypto()
     header = {"alg": "RS256", "typ": "JWT"}
     if kid is not None:
         header["kid"] = kid
@@ -95,6 +123,7 @@ def decode_unverified(token: str) -> Tuple[dict, dict]:
 def verify_rs256(token: str, public_key) -> dict:
     """Verify signature; returns the payload.  Claims semantics (exp,
     iss, aud, scopes) are the Authorizer's job."""
+    _crypto()
     header, payload, signing_input, sig = split(token)
     if header.get("alg") != "RS256":
         raise JWTError(f"unsupported alg: {header.get('alg')!r}")

@@ -955,6 +955,21 @@ class DSSStore:
         finally:
             self._replaying = False
 
+    def configure_serving(self, **knobs) -> None:
+        """Fan serving-pipeline knobs (QueryCoalescer.configure:
+        min_batch / max_batch / target_batch_ms / queue_depth /
+        admission_wait_s / inline) out to every entity class's
+        coalescer.  Boot-time defaults come from DSS_CO_* env vars
+        (coalesce.env_knobs); this is the runtime override for ops
+        tuning and tests.  No-op on the memory backend."""
+        for index in (
+            self.rid._isa_index, self.rid._sub_index,
+            self.scd._op_index, self.scd._sub_index,
+        ):
+            co = getattr(index, "coalescer", None)
+            if co is not None:
+                co.configure(**knobs)
+
     def attach_mesh_replica(self, replica, min_batch: int = 64) -> None:
         """Route oversized bounded-staleness search batches from each
         entity class's coalescer to the multi-chip replica when it is

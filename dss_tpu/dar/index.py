@@ -18,6 +18,7 @@ import numpy as np
 
 from dss_tpu.dar import oracle
 from dss_tpu.dar.coalesce import QueryCoalescer
+from dss_tpu.dar.coalesce import env_knobs as coalesce_env_knobs
 from dss_tpu.dar.oracle import Record
 from dss_tpu.dar.snapshot import DarTable
 from dss_tpu.geo import s2cell
@@ -78,8 +79,12 @@ class TpuSpatialIndex:
     def __init__(self, **table_kwargs):
         self._table = DarTable(**table_kwargs)
         # concurrent readers (one thread per in-flight request) are
-        # micro-batched into single fused kernel launches
-        self._coalescer = QueryCoalescer(self._table)
+        # micro-batched into single fused kernel launches; serving
+        # knobs come from DSS_CO_* env vars (docs/SERVING.md) and can
+        # be adjusted at runtime via DSSStore.configure_serving
+        self._coalescer = QueryCoalescer(
+            self._table, **coalesce_env_knobs()
+        )
 
     def put(self, id, cells_u64, alt_lo, alt_hi, t_start, t_end, owner_id):
         self._table.upsert(
@@ -119,7 +124,10 @@ class TpuSpatialIndex:
 
     def stats(self) -> dict:
         out = self._table.stats()
-        out["mesh_offloads"] = self._coalescer.mesh_offloads
+        # serving-pipeline gauges (queue depth, adaptive batch size,
+        # pack/device/collect stage totals, shed count) ride along and
+        # land in /metrics as dss_dar_<class>_co_* via DSSStore.stats()
+        out.update(self._coalescer.stats())
         return out
 
     @property

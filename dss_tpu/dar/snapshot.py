@@ -234,6 +234,41 @@ def _overlay_search(
     return cq[keep].astype(np.int64), cand[keep].astype(np.int64)
 
 
+class _PendingQuery:
+    """One in-flight query_many batch: the immutable state it runs
+    against plus either ready host-path hits or a device PendingBatch.
+    Produced by DarTable.query_many_submit, resolved by
+    DarTable.query_many_collect — the two halves the pipelined
+    QueryCoalescer overlaps (pack batch N+1 while batch N is on the
+    device)."""
+
+    __slots__ = (
+        "st", "b", "qkeys", "alt_lo", "alt_hi", "t_start", "t_end",
+        "now_arr", "owner_ids", "host", "pending",
+    )
+
+    def __init__(self, st, b, qkeys, alt_lo, alt_hi, t_start, t_end,
+                 now_arr, owner_ids, host, pending):
+        self.st = st
+        self.b = b
+        self.qkeys = qkeys
+        self.alt_lo = alt_lo
+        self.alt_hi = alt_hi
+        self.t_start = t_start
+        self.t_end = t_end
+        self.now_arr = now_arr
+        self.owner_ids = owner_ids
+        self.host = host  # (qidx, slots) from the exact host path
+        self.pending = pending  # fastpath.PendingBatch (device in flight)
+
+    def wait_device(self) -> None:
+        """Block until the device results are ready (no data fetch, no
+        decode) — lets the pipelined caller time the pure device wait
+        separately from the host decode in collect."""
+        if self.pending is not None:
+            self.pending.ready()
+
+
 class DarTable:
     """HBM spatial index for one entity class: lock-free reads against
     the published immutable state; copy-on-write writes; background
@@ -525,7 +560,7 @@ class DarTable:
             else np.asarray([owner_id], np.int32),
         )[0]
 
-    def query_many(
+    def query_many_submit(
         self,
         keys_list,  # sequence of int32 arrays (DAR keys per query)
         alt_lo: np.ndarray,  # f32[B], -inf unbounded
@@ -536,14 +571,17 @@ class DarTable:
         now,  # int scalar or i64[B] per-query
         owner_ids: Optional[np.ndarray] = None,  # i32[B], -1 = no filter
         state: Optional[_State] = None,  # pre-grabbed state (internal)
-    ) -> List[List[str]]:
-        """Batched search via the fused fast path + overlay scan.
-        Lock-free: runs against ONE atomically-grabbed immutable state."""
+    ) -> Optional[_PendingQuery]:
+        """The host/pack half of query_many: grab ONE immutable state,
+        pack the query batch, and either answer small batches from the
+        exact host postings copy or enqueue the fused device kernel
+        (async — nothing here blocks on the device).  Returns a handle
+        for query_many_collect; None for an empty batch.  Pipelined
+        callers overlap this with a previous batch's collect."""
         st = state if state is not None else self._state
         b = len(keys_list)
         if b == 0:
-            return []
-        out_sets = [set() for _ in range(b)]
+            return None
         now_arr = np.broadcast_to(np.asarray(now, np.int64), (b,))
         width = max(16, pow2_at_least(max(len(k) for k in keys_list), lo=16))
         qkeys = np.full((b, width), -1, np.int32)
@@ -560,6 +598,8 @@ class DarTable:
         if dup.any():
             qkeys[:, 1:][dup] = -1
 
+        host = None
+        pending = None
         if st.snap.fast is not None:
             # small batches answer from the host postings copy (exact,
             # native C++ when built) instead of paying a device round
@@ -567,32 +607,50 @@ class DarTable:
             host = st.snap.fast.query_host_auto(
                 qkeys, alt_lo, alt_hi, t_start, t_end, now=now_arr
             )
-            if host is not None:
-                qidx, slots = host
-            else:
+            if host is None:
                 if budget.is_host_only():
                     # caller is on the event loop: re-run via executor
                     raise budget.NeedsDevice()
-                qidx, slots = st.snap.fast.query_fused(
+                pending = st.snap.fast.submit(
                     qkeys, alt_lo, alt_hi, t_start, t_end, now=now_arr
                 )
+        return _PendingQuery(
+            st, b, qkeys, alt_lo, alt_hi, t_start, t_end, now_arr,
+            owner_ids, host, pending,
+        )
+
+    def query_many_collect(self, pq: Optional[_PendingQuery]) -> List[List[str]]:
+        """The collect/decode half of query_many: resolve the device
+        batch (the one host sync), then dead-slot/owner filtering, the
+        overlay scan, and id assembly — all against the state grabbed
+        at submit time, so the (snapshot, overlay, dead) triple stays
+        consistent across the pipeline gap."""
+        if pq is None:
+            return []
+        st = pq.st
+        out_sets = [set() for _ in range(pq.b)]
+        if st.snap.fast is not None:
+            if pq.host is not None:
+                qidx, slots = pq.host
+            else:
+                qidx, slots = st.snap.fast.collect(pq.pending)
             if len(qidx):
                 if st.dead:
                     keep = ~np.isin(
                         slots, np.fromiter(st.dead, np.int64, len(st.dead))
                     )
                     qidx, slots = qidx[keep], slots[keep]
-                if owner_ids is not None and len(qidx):
-                    keep = (owner_ids[qidx] < 0) | (
-                        st.snap.owner[slots] == owner_ids[qidx]
+                if pq.owner_ids is not None and len(qidx):
+                    keep = (pq.owner_ids[qidx] < 0) | (
+                        st.snap.owner[slots] == pq.owner_ids[qidx]
                     )
                     qidx, slots = qidx[keep], slots[keep]
             _scatter_hits(out_sets, qidx, slots, st.snap.ids)
 
         if st.overlay is not None:
             oq, oent = _overlay_search(
-                st.overlay, qkeys, alt_lo, alt_hi, t_start, t_end,
-                now_arr, owner_ids,
+                st.overlay, pq.qkeys, pq.alt_lo, pq.alt_hi, pq.t_start,
+                pq.t_end, pq.now_arr, pq.owner_ids,
             )
             _scatter_hits(out_sets, oq, oent, st.overlay.ids)
 
@@ -600,6 +658,29 @@ class DarTable:
         # overlay only (its old slot is in st.dead); sets dedup any
         # transient double-sighting.  Sorted for deterministic responses.
         return [sorted(s) for s in out_sets]
+
+    def query_many(
+        self,
+        keys_list,  # sequence of int32 arrays (DAR keys per query)
+        alt_lo: np.ndarray,  # f32[B], -inf unbounded
+        alt_hi: np.ndarray,
+        t_start: np.ndarray,  # i64[B] ns, NO_TIME_LO unbounded
+        t_end: np.ndarray,
+        *,
+        now,  # int scalar or i64[B] per-query
+        owner_ids: Optional[np.ndarray] = None,  # i32[B], -1 = no filter
+        state: Optional[_State] = None,  # pre-grabbed state (internal)
+    ) -> List[List[str]]:
+        """Batched search via the fused fast path + overlay scan.
+        Lock-free: runs against ONE atomically-grabbed immutable state.
+        submit+collect in one call; the pipelined QueryCoalescer calls
+        the halves separately to overlap host pack with device work."""
+        return self.query_many_collect(
+            self.query_many_submit(
+                keys_list, alt_lo, alt_hi, t_start, t_end,
+                now=now, owner_ids=owner_ids, state=state,
+            )
+        )
 
     def max_owner_count(self, keys: np.ndarray, owner_id: int, *, now: int) -> int:
         """DSS0030 quota metric: max per-cell count of live entities owned

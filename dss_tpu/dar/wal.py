@@ -30,6 +30,15 @@ class LogFormatError(RuntimeError):
     """The log was written by an unsupported (newer) format."""
 
 
+class LogCorruptError(RuntimeError):
+    """The log has an undecodable region FOLLOWED by valid records —
+    mid-log corruption (bit rot, partial page write), not a crash-torn
+    tail.  Truncating here would silently delete fsync-acked records,
+    so boot refuses instead; the file is left byte-for-byte intact for
+    repair/forensics (the quarantine).  Operators repair or move the
+    file aside explicitly to proceed."""
+
+
 def format_record() -> dict:
     return {"t": FORMAT_RECORD_TYPE, "version": FORMAT_VERSION}
 
@@ -65,8 +74,22 @@ class WriteAheadLog:
                 # into one garbage line that a later replay drops
                 # (silent loss of that write and everything after it),
                 # so truncate to the last complete record first.
+                # Truncation is ONLY legal when the invalid region
+                # extends to EOF (a true crash tear): valid records
+                # after an undecodable line mean mid-log corruption,
+                # and deleting them would be silent loss of
+                # fsync-acked writes — refuse to start instead.
                 valid, self._seq = self._recover(path)
                 if valid < os.path.getsize(path):
+                    if self._valid_records_after(path, valid):
+                        raise LogCorruptError(
+                            f"log {path} is corrupt at byte {valid}: "
+                            "valid records exist after an undecodable "
+                            "region (mid-log corruption, not a crash "
+                            "tear).  Refusing to truncate acked "
+                            "records; repair the file or move it "
+                            "aside to proceed."
+                        )
                     with open(path, "r+b") as fh:
                         fh.truncate(valid)
             # re-stat AFTER truncation: a fully-torn header line must
@@ -104,14 +127,46 @@ class WriteAheadLog:
                 if stripped:
                     try:
                         rec = json.loads(stripped)
-                    except json.JSONDecodeError:
+                    except ValueError:
+                        # JSONDecodeError, or UnicodeDecodeError from
+                        # json's encoding sniff on rotted bytes (e.g.
+                        # NUL runs look like UTF-32) — both ValueError
                         break
+                    if not isinstance(rec, dict):
+                        break  # rot that decodes as a JSON scalar
                     if first:
                         first = False
                         check_format_record(rec, path)
                     seq = max(seq, rec.get("seq", 0))
                 valid = fh.tell()
         return valid, seq
+
+    @staticmethod
+    def _valid_records_after(path: str, offset: int) -> bool:
+        """True when any complete, decodable JSON record line exists
+        AFTER the undecodable line at `offset` — the mid-log-corruption
+        discriminator.  A torn tail (the common crash shape) has
+        nothing decodable after it; bit rot in the middle does."""
+        with open(path, "rb") as fh:
+            fh.seek(offset)
+            bad = fh.readline()
+            if not bad.endswith(b"\n"):
+                return False  # the bad region runs to EOF: a tear
+            while True:
+                line = fh.readline()
+                if not line:
+                    return False
+                if not line.endswith(b"\n"):
+                    return False  # only a torn tail remains
+                stripped = line.strip()
+                if not stripped:
+                    continue
+                try:
+                    rec = json.loads(stripped)
+                except ValueError:  # undecodable bytes or bad JSON
+                    continue  # more damage; keep scanning
+                if isinstance(rec, dict):
+                    return True
 
     @property
     def seq(self) -> int:
@@ -143,9 +198,11 @@ class WriteAheadLog:
                     continue
                 try:
                     rec = json.loads(line)
-                except json.JSONDecodeError:
+                except ValueError:
                     # torn tail write (crash mid-append): stop replay here
                     return
+                if not isinstance(rec, dict):
+                    return  # same: not a complete record
                 if first:
                     first = False
                     check_format_record(rec, self.path)

@@ -115,6 +115,17 @@ def exhausted(msg: str) -> StatusError:
     return StatusError(Code.RESOURCE_EXHAUSTED, msg)
 
 
+class OverloadedError(StatusError):
+    """The serving queue is full: the request was shed at admission
+    instead of joining an unbounded backlog (bounded-latency overload
+    degradation).  Maps to HTTP 429; `retry_after_s` is the estimated
+    queue-drain horizon the transport layer surfaces as Retry-After."""
+
+    def __init__(self, message: str, retry_after_s: float = 1.0):
+        super().__init__(Code.RESOURCE_EXHAUSTED, message)
+        self.retry_after_s = float(retry_after_s)
+
+
 def permission_denied(msg: str) -> StatusError:
     return StatusError(Code.PERMISSION_DENIED, msg)
 

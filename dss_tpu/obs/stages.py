@@ -28,6 +28,17 @@ def get_sink():
     return getattr(_tls, "sink", None)
 
 
+def mark(name: str, duration_ms: float) -> None:
+    """Record an externally-measured duration into the current sink
+    (no-op without one).  For callers that cannot bracket the timed
+    region with `stage` — e.g. the coalescer recording how long an
+    item waited for its micro-batch.  Repeated marks accumulate."""
+    sink = getattr(_tls, "sink", None)
+    if sink is None:
+        return
+    sink[name] = round(sink.get(name, 0.0) + duration_ms, 3)
+
+
 @contextmanager
 def stage(name: str):
     """Time a block into the current sink (no-op without a sink).

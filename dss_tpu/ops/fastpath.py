@@ -243,6 +243,16 @@ class PendingBatch:
         self.nw = nw
         self.max_words = max_words
 
+    def ready(self) -> None:
+        """Block until the device computation has completed (readiness
+        only — no data fetch, no decode).  Lets a pipelined caller
+        (the coalescer's collect stage) time the pure device wait
+        separately from collect()'s D2H + decode."""
+        try:
+            self.out.block_until_ready()
+        except Exception:  # interpret/older backends: collect() blocks
+            pass
+
 
 class FastTable:
     """Device-resident packed postings + host decode state."""
@@ -322,6 +332,15 @@ class FastTable:
             self.slot_exact = {
                 k: np.asarray(v) for k, v in slot_exact.items()
             }
+            # normalize the live column to a contiguous buffer HERE,
+            # where no concurrent mutator can exist yet: mark_dead()
+            # flips bits of THIS array in place and the native host
+            # path caches a uint8 view of the same memory — adopting a
+            # contiguous copy lazily on the query path (as before)
+            # could lose a tombstone that raced the adoption
+            self.slot_exact["live"] = np.ascontiguousarray(
+                self.slot_exact["live"]
+            )
 
     # -- device kernels ------------------------------------------------------
 
@@ -777,13 +796,10 @@ class FastTable:
                 # uint8 view shares its memory) — prepare once.
                 se = self.slot_exact
                 hk, sample, sample0 = self._sample_index()
-                live = np.ascontiguousarray(se["live"])
-                # adopt the contiguous buffer as THE live column:
-                # mark_dead mutates slot_exact["live"] in place, and
-                # the cached uint8 view must see those flips even when
-                # the original input was non-contiguous (where
-                # ascontiguousarray copies)
-                se["live"] = live
+                # live was normalized to a contiguous buffer in
+                # __init__, so this view shares memory with the array
+                # mark_dead() mutates — no adoption race on this path
+                live = se["live"]
                 cols = self._hostq_cols = (
                     hk,
                     np.ascontiguousarray(self.host_ent, np.int32),

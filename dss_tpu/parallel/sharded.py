@@ -28,8 +28,23 @@ from typing import List, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+try:  # jax >= 0.5 exports shard_map at top level
+    from jax import shard_map
+except ImportError:  # older jax: the experimental location
+    from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# the "skip the replication check" kwarg was renamed check_rep ->
+# check_vma across jax versions; resolve the supported name once
+import inspect as _inspect
+
+_SHMAP_NOCHECK = {
+    (
+        "check_vma"
+        if "check_vma" in _inspect.signature(shard_map).parameters
+        else "check_rep"
+    ): False
+}
 
 from dss_tpu.dar import oracle
 from dss_tpu.dar.oracle import Record
@@ -172,7 +187,7 @@ def sharded_conflict_query_batch(
             qspec,  # owner
         ),
         out_specs=(P("dp", None), P("dp")),
-        check_vma=False,
+        **_SHMAP_NOCHECK,
     )(
         post_key,
         post_ent,

@@ -9,6 +9,7 @@ altitudes as {"value": ..., "reference": "W84", "units": "M"}
 
 from __future__ import annotations
 
+import re
 from datetime import datetime, timezone
 from typing import Optional
 
@@ -72,6 +73,13 @@ def parse_time(s: str) -> datetime:
     raw = s.strip()
     if raw.endswith(("z", "Z")):
         raw = raw[:-1] + "+00:00"
+    # Python < 3.11 fromisoformat only accepts 3- or 6-digit fractional
+    # seconds; RFC3339 allows any width (format_time itself emits
+    # trailing-zero-stripped fractions) — pad to 6
+    m = re.fullmatch(r"(.*T\d\d:\d\d:\d\d)\.(\d+)(.*)", raw)
+    if m and len(m.group(2)) not in (3, 6):
+        frac = (m.group(2) + "000000")[:6]
+        raw = f"{m.group(1)}.{frac}{m.group(3)}"
     t = datetime.fromisoformat(raw)
     if t.tzinfo is None:
         t = t.replace(tzinfo=timezone.utc)

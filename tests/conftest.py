@@ -32,13 +32,17 @@ if not _USE_TPU:
 
 
 import pytest
-from cryptography.hazmat.primitives import serialization
-from cryptography.hazmat.primitives.asymmetric import rsa
 
 
 @pytest.fixture(scope="session")
 def keypair():
-    """One RS256 keypair per test session (PEM private, PEM public)."""
+    """One RS256 keypair per test session (PEM private, PEM public).
+    Skips the requesting test when `cryptography` (an optional
+    dependency — auth is disableable) is not installed."""
+    pytest.importorskip("cryptography")
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+
     key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
     priv = key.private_bytes(
         serialization.Encoding.PEM,

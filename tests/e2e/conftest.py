@@ -25,6 +25,8 @@ from pathlib import Path
 
 import pytest
 import requests
+
+pytest.importorskip("cryptography")
 from cryptography.hazmat.primitives import serialization
 from cryptography.hazmat.primitives.asymmetric import rsa
 

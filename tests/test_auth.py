@@ -4,6 +4,8 @@ resolution — mirroring pkg/auth/auth_test.go + claims.go semantics."""
 import time
 
 import pytest
+
+pytest.importorskip("cryptography")
 from cryptography.hazmat.primitives import serialization
 from cryptography.hazmat.primitives.asymmetric import rsa
 

@@ -4,16 +4,15 @@ main.go:217-221)."""
 
 from __future__ import annotations
 
-import asyncio
 import threading
 import time
 
 import pytest
 import requests
-from aiohttp import web
 
 from dss_tpu import errors
 from dss_tpu.api.app import build_app
+from tests.live_server import LiveServer
 
 
 class SlowRID:
@@ -30,44 +29,6 @@ class SlowRID:
 
     def get_isa(self, id, owner=None):
         return {"service_area": {"id": id}}
-
-
-class LiveServer:
-    def __init__(self, app: web.Application, shutdown_timeout=25.0):
-        self.app = app
-        self.loop = asyncio.new_event_loop()
-        self.port = None
-        self.shutdown_timeout = shutdown_timeout
-        self._started = threading.Event()
-        self._runner = None
-        self.thread = threading.Thread(target=self._run, daemon=True)
-        self.thread.start()
-        assert self._started.wait(30)
-        self.base = f"http://127.0.0.1:{self.port}"
-
-    def _run(self):
-        asyncio.set_event_loop(self.loop)
-        self._runner = web.AppRunner(
-            self.app, shutdown_timeout=self.shutdown_timeout
-        )
-        self.loop.run_until_complete(self._runner.setup())
-        site = web.TCPSite(self._runner, "127.0.0.1", 0)
-        self.loop.run_until_complete(site.start())
-        self.port = site._server.sockets[0].getsockname()[1]
-        self._started.set()
-        self.loop.run_forever()
-
-    def drain(self):
-        """The SIGTERM path: stop accepting, wait for in-flight
-        requests (up to shutdown_timeout), close."""
-        fut = asyncio.run_coroutine_threadsafe(
-            self._runner.cleanup(), self.loop
-        )
-        fut.result(timeout=self.shutdown_timeout + 10)
-
-    def stop(self):
-        self.loop.call_soon_threadsafe(self.loop.stop)
-        self.thread.join(timeout=10)
 
 
 def test_hung_handler_times_out_504():

@@ -124,6 +124,8 @@ def test_make_certs_provisions_trust_material(tmp_path):
     import subprocess
     import sys
 
+    pytest.importorskip("cryptography")  # make_certs signs with RSA
+
     out = tmp_path / "trust"
     r = subprocess.run(
         [

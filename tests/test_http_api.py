@@ -3,13 +3,12 @@
 docker_e2e.sh/prober analog, monitoring/prober/{rid,scd}).  Auth
 enforced on every route."""
 
-import asyncio
-import threading
 import time
 
 import pytest
 import requests
-from aiohttp import web
+
+pytest.importorskip("cryptography")
 from cryptography.hazmat.primitives import serialization
 from cryptography.hazmat.primitives.asymmetric import rsa
 
@@ -22,33 +21,7 @@ from dss_tpu.services.rid import RIDService
 from dss_tpu.services.scd import SCDService
 
 
-class LiveServer:
-    """Runs an aiohttp app on 127.0.0.1:<ephemeral> in a daemon thread."""
-
-    def __init__(self, app: web.Application):
-        self.app = app
-        self.loop = asyncio.new_event_loop()
-        self.port = None
-        self._started = threading.Event()
-        self.thread = threading.Thread(target=self._run, daemon=True)
-        self.thread.start()
-        if not self._started.wait(30):
-            raise RuntimeError("server failed to start")
-        self.base = f"http://127.0.0.1:{self.port}"
-
-    def _run(self):
-        asyncio.set_event_loop(self.loop)
-        runner = web.AppRunner(self.app)
-        self.loop.run_until_complete(runner.setup())
-        site = web.TCPSite(runner, "127.0.0.1", 0)
-        self.loop.run_until_complete(site.start())
-        self.port = site._server.sockets[0].getsockname()[1]
-        self._started.set()
-        self.loop.run_forever()
-
-    def stop(self):
-        self.loop.call_soon_threadsafe(self.loop.stop)
-        self.thread.join(timeout=10)
+from tests.live_server import LiveServer  # shared harness (crypto-free)
 
 AUD = "dss.example.com"
 ISA1 = "dddddddd-dddd-4ddd-8ddd-ddddddddddd1"

@@ -11,7 +11,7 @@ import logging
 import requests
 
 from dss_tpu.api.app import build_app
-from tests.test_deadlines import LiveServer
+from tests.live_server import LiveServer
 
 
 class EchoRID:

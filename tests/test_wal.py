@@ -123,6 +123,42 @@ def test_wal_boot_survives_any_truncation(tmp_path):
         os.unlink(trial)
 
 
+def test_wal_mid_log_corruption_refuses_boot(tmp_path):
+    """An undecodable line with valid records AFTER it is mid-log
+    corruption (bit rot / partial page write), not a crash tear:
+    truncating there would silently delete fsync-acked records.  Boot
+    must refuse with LogCorruptError and leave the file byte-for-byte
+    intact (the quarantine) for repair/forensics."""
+    from dss_tpu.dar.wal import LogCorruptError
+
+    path = str(tmp_path / "dss.wal")
+    wal = WriteAheadLog(path)
+    for t in ("a", "b", "c"):
+        wal.append({"t": t})
+    wal.close()
+
+    raw = open(path, "rb").read()
+    lines = raw.splitlines(keepends=True)
+    assert len(lines) == 4  # header + 3 records
+    # rot record "b" in place (same length, still newline-terminated)
+    lines[2] = b"\x00" * (len(lines[2]) - 1) + b"\n"
+    corrupt = b"".join(lines)
+    with open(path, "wb") as fh:
+        fh.write(corrupt)
+
+    with pytest.raises(LogCorruptError):
+        WriteAheadLog(path)
+    # quarantined, not truncated: record "c" is still in the file
+    assert open(path, "rb").read() == corrupt
+
+    # contrast: the same damage at the TAIL is a crash tear — boot
+    # truncates to the valid prefix and proceeds
+    with open(path, "wb") as fh:
+        fh.write(b"".join(lines[:2]) + b'{"t": "d", "se')
+    recs = list(WriteAheadLog(path).replay())
+    assert [r["t"] for r in recs] == ["a"]
+
+
 def test_wal_torn_header_gets_fresh_header(tmp_path):
     """A crash mid-HEADER write (the whole file is one torn line) must
     recover to a properly headered log: truncate to empty, then write
