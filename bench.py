@@ -457,7 +457,116 @@ def curve_leg(table, n_cells, width, rates, secs, warm_s=1.0):
     return rows, (max(ok) if ok else 0)
 
 
+def workers_leg():
+    """Multi-worker scaling smoke (`bench.py --leg workers`): boots the
+    REAL server binary with --workers 0 (single process) and
+    --workers N (leader + N SO_REUSEPORT read workers) on this host
+    and measures closed-loop RID search throughput through the full
+    HTTP stack — out-of-process raw-socket clients, so client CPU is
+    never billed to the server.  The measured speedup is what sizes
+    --workers in docs/OPERATIONS.md; run it on YOUR host shape, the
+    ratio is core-count dependent.  Prints one JSON line."""
+    from benchmarks.bench_rid_search import (
+        _drive,
+        _free_port,
+        boot_server,
+        populate_isas,
+        wait_for_healthy,
+    )
+
+    cpus = os.cpu_count() or 1
+    workers_n = int(
+        os.environ.get("DSS_BENCH_WORKERS", max(1, min(cpus - 1, 4)))
+    )
+    n_isas = int(os.environ.get("DSS_BENCH_ISAS", 300))
+    secs = float(os.environ.get("DSS_BENCH_SECS", 6))
+    procs = int(os.environ.get("DSS_BENCH_PROCS", min(4, max(2, cpus))))
+    threads = int(os.environ.get("DSS_BENCH_THREADS", 3))
+    # memory storage: the leg isolates the WORKER fan-out (HTTP +
+    # covering + index scan on every worker), not device placement
+    storage = os.environ.get("DSS_BENCH_STORAGE", "memory")
+
+    import subprocess
+
+    rows = []
+    for w in sorted({0, workers_n}):
+        port = _free_port()
+        base = f"http://127.0.0.1:{port}"
+        srv = boot_server(port, storage, w)
+        try:
+            wait_for_healthy(base)
+            populate_isas(base, n_isas)
+            time.sleep(1.0)  # worker replicas catch the populate tail
+            qps, p50, p99, n, _ = _drive(
+                base, procs=procs, threads=threads, warm_s=2.0, run_s=secs
+            )
+            rows.append(
+                {
+                    "workers": w,
+                    "qps": round(qps, 1),
+                    "p50_ms": round(p50, 2),
+                    "p99_ms": round(p99, 2),
+                    "samples": n,
+                }
+            )
+        finally:
+            srv.terminate()
+            try:
+                srv.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                srv.kill()
+    single, multi = rows[0], rows[-1]
+    speedup = (
+        round(multi["qps"] / single["qps"], 3) if single["qps"] else None
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "rid_search_worker_scaling",
+                "value": multi["qps"],
+                "unit": "searches/s",
+                # scaling factor over the single-process server ON THIS
+                # HOST — the number the --workers sizing advice cites
+                "vs_baseline": speedup,
+                "detail": {
+                    "host_cpus": cpus,
+                    "workers": multi["workers"],
+                    "single_process_qps": single["qps"],
+                    "speedup_vs_single_process": speedup,
+                    "rows": rows,
+                    "isas": n_isas,
+                    "client_procs": procs,
+                    "client_threads_per_proc": threads,
+                    "storage": storage,
+                    "note": (
+                        "closed-loop RID area search via SO_REUSEPORT "
+                        "read workers (WAL-tail replicas); on 1-core "
+                        "hosts expect speedup <= 1 (context switching "
+                        "only) — size --workers from the measured "
+                        "speedup, not a cores heuristic"
+                    ),
+                },
+            }
+        )
+    )
+
+
 def main():
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--leg",
+        choices=["north-star", "workers"],
+        default="north-star",
+        help="'north-star': the headline SCD conflict-qps benchmark "
+        "(default); 'workers': multi-worker HTTP serving scaling smoke "
+        "(--workers 0 vs N through the real binary)",
+    )
+    args = ap.parse_args()
+    if args.leg == "workers":
+        return workers_leg()
+
     n_entities = int(os.environ.get("DSS_BENCH_ENTITIES", 1_000_000))
     n_cells = int(os.environ.get("DSS_BENCH_CELLS", 200_000))
     kpe = 8

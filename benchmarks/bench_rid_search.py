@@ -204,6 +204,71 @@ def _stage_summary(samples):
     return out
 
 
+def boot_server(port, storage, workers, wal_path=None):
+    """Launch the real server binary (no auth, stage tracing on) and
+    return the Popen.  Callers own terminate/kill."""
+    argv = [
+        sys.executable, "-m", "dss_tpu.cmds.server",
+        "--addr", f":{port}",
+        "--storage", storage,
+        "--insecure_no_auth",
+        "--trace_requests",
+        "--workers", str(workers),
+        "--no_warmup",
+    ]
+    if wal_path:
+        # --workers N serves searches from WAL-tail replicas: the
+        # leader must journal for the read workers to have a tail
+        argv += ["--wal_path", str(wal_path)]
+    return subprocess.Popen(argv, env=dict(os.environ, DSS_LOG_LEVEL="error"))
+
+
+def wait_for_healthy(base, deadline_s=60.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < deadline_s:
+        try:
+            if requests.get(f"{base}/healthy", timeout=2).ok:
+                return
+        except requests.RequestException:
+            pass
+        time.sleep(0.5)
+    raise RuntimeError("server did not become healthy")
+
+
+def populate_isas(base, n_isas, seed=0):
+    """Populate one metro region of small-polygon ISAs."""
+    rng = np.random.default_rng(seed)
+    s = requests.Session()
+    for _ in range(n_isas):
+        la = float(LAT0 + rng.uniform(0, SPAN))
+        ln = float(LNG0 + rng.uniform(0, SPAN))
+        body = {
+            "extents": {
+                "spatial_volume": {
+                    "footprint": {
+                        "vertices": [
+                            {"lat": la, "lng": ln},
+                            {"lat": la + 0.01, "lng": ln},
+                            {"lat": la + 0.01, "lng": ln + 0.01},
+                            {"lat": la, "lng": ln + 0.01},
+                        ]
+                    },
+                    "altitude_lo": 20.0,
+                    "altitude_hi": 400.0,
+                },
+                "time_start": now_iso(60),
+                "time_end": now_iso(3600),
+            },
+            "flights_url": "https://uss.example.com/flights",
+        }
+        r = s.put(
+            f"{base}/v1/dss/identification_service_areas/{uuid.uuid4()}",
+            json=body,
+            timeout=60,
+        )
+        assert r.status_code == 200, r.text
+
+
 def main():
     cpus = os.cpu_count() or 1
     # on a single core, extra processes only add context switching —
@@ -219,60 +284,10 @@ def main():
 
     port = _free_port()
     base = f"http://127.0.0.1:{port}"
-    srv = subprocess.Popen(
-        [
-            sys.executable, "-m", "dss_tpu.cmds.server",
-            "--addr", f":{port}",
-            "--storage", storage,
-            "--insecure_no_auth",
-            "--trace_requests",
-            "--workers", str(workers),
-            "--no_warmup",
-        ],
-        env=dict(os.environ, DSS_LOG_LEVEL="error"),
-    )
+    srv = boot_server(port, storage, workers)
     try:
-        for _ in range(120):
-            try:
-                if requests.get(f"{base}/healthy", timeout=2).ok:
-                    break
-            except requests.RequestException:
-                pass
-            time.sleep(0.5)
-        else:
-            raise RuntimeError("server did not become healthy")
-
-        # populate one metro region of small-polygon ISAs
-        rng = np.random.default_rng(0)
-        s = requests.Session()
-        for _ in range(n_isas):
-            la = float(LAT0 + rng.uniform(0, SPAN))
-            ln = float(LNG0 + rng.uniform(0, SPAN))
-            body = {
-                "extents": {
-                    "spatial_volume": {
-                        "footprint": {
-                            "vertices": [
-                                {"lat": la, "lng": ln},
-                                {"lat": la + 0.01, "lng": ln},
-                                {"lat": la + 0.01, "lng": ln + 0.01},
-                                {"lat": la, "lng": ln + 0.01},
-                            ]
-                        },
-                        "altitude_lo": 20.0,
-                        "altitude_hi": 400.0,
-                    },
-                    "time_start": now_iso(60),
-                    "time_end": now_iso(3600),
-                },
-                "flights_url": "https://uss.example.com/flights",
-            }
-            r = s.put(
-                f"{base}/v1/dss/identification_service_areas/{uuid.uuid4()}",
-                json=body,
-                timeout=60,
-            )
-            assert r.status_code == 200, r.text
+        wait_for_healthy(base)
+        populate_isas(base, n_isas)
         time.sleep(1.0)  # let worker replicas catch up
 
         # light load: per-request latency without closed-loop queueing

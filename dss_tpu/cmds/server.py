@@ -89,8 +89,10 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--region_url",
         default="",
-        help="region log server URL; joins this instance to a "
-        "multi-instance DSS Region (replaces the local WAL)",
+        help="region log server URL(s), comma-separated primary + "
+        "mirrors; joins this instance to a multi-instance DSS Region "
+        "(replaces the local WAL).  With mirrors listed, the client "
+        "fails over on connection errors / 503 not-primary",
     )
     p.add_argument(
         "--region_token_file",

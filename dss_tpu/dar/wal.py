@@ -65,6 +65,13 @@ class WriteAheadLog:
         self._lock = threading.Lock()
         self._seq = 0
         self._fh = None
+        # True when boot recovery truncated a torn tail: with fsync
+        # off, acked records may have been lost with the tear, so the
+        # log's history is no longer guaranteed to be a superset of
+        # what readers saw.  The region log rotates its persisted
+        # epoch on this signal (and ONLY this signal or promotion) so
+        # clean restarts no longer fence every writer.
+        self.recovered_truncation = False
         if path is not None:
             os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
             if os.path.exists(path) and os.path.getsize(path) > 0:
@@ -92,6 +99,7 @@ class WriteAheadLog:
                         )
                     with open(path, "r+b") as fh:
                         fh.truncate(valid)
+                    self.recovered_truncation = True
             # re-stat AFTER truncation: a fully-torn header line must
             # count as a fresh log and get a fresh format header
             fresh = os.path.getsize(path) == 0 if os.path.exists(
@@ -183,6 +191,15 @@ class WriteAheadLog:
                 if self.fsync:
                     os.fsync(self._fh.fileno())
             return self._seq
+
+    def sync(self) -> None:
+        """fsync the log regardless of the per-append fsync setting —
+        for rare, must-survive records (epoch rotations) on deployments
+        that run with fsync off for throughput."""
+        with self._lock:
+            if self._fh is not None:
+                self._fh.flush()
+                os.fsync(self._fh.fileno())
 
     def replay(self) -> Iterator[dict]:
         """Yield records in order; tolerates a torn final line.  Raises

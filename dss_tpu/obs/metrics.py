@@ -67,6 +67,7 @@ class MetricsRegistry:
         self._hist_sum: Dict[Tuple[str, str], float] = {}
         self._hist_cnt: Dict[Tuple[str, str], int] = {}
         self._gauges: Dict[str, float] = {}
+        self._scalar_counters: Dict[str, float] = {}
         self._infos: Dict[str, Dict[str, str]] = {}
         self._stage_sum: Dict[Tuple[str, str], float] = {}
         self._stage_cnt: Dict[Tuple[str, str], int] = {}
@@ -100,6 +101,14 @@ class MetricsRegistry:
     def set_gauge(self, name: str, value: float) -> None:
         with self._lock:
             self._gauges[name] = float(value)
+
+    def set_counter(self, name: str, value: float) -> None:
+        """Label-less monotonic counter exposed with the proper
+        `# TYPE ... counter` so rate()/increase() semantics hold for
+        restart-reset series (the region server's failover counters).
+        The caller owns monotonicity; this just publishes the value."""
+        with self._lock:
+            self._scalar_counters[name] = float(value)
 
     def set_info(self, name: str, labels: Dict[str, str]) -> None:
         """Prometheus info-pattern gauge: <name>{k="v",...} 1 (e.g.
@@ -179,6 +188,12 @@ class MetricsRegistry:
                         f"dss_request_stage_seconds_count{{{l}}} "
                         f"{self._stage_cnt[k]}"
                     )
+            for name, v in sorted(self._scalar_counters.items()):
+                lines.append(f"# TYPE {name} counter")
+                if pl:
+                    lines.append(f"{name}{{{pl}}} {v}")
+                else:
+                    lines.append(f"{name} {v}")
             for name, v in sorted(self._gauges.items()):
                 lines.append(f"# TYPE {name} gauge")
                 if pl:

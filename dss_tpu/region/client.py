@@ -1,8 +1,21 @@
-"""Synchronous client for the region log server (DSS-instance side)."""
+"""Synchronous client for the region log server (DSS-instance side).
+
+Accepts one URL or a list (comma-separated string or list/tuple) —
+the primary plus its mirrors.  Every request gets bounded, jittered
+transport retry with endpoint failover: connection errors, 5xx, and
+`503 not-primary` answers rotate to the next endpoint (following the
+server's `primary` hint when it names a configured endpoint), so a
+mirror hiccup or a
+failover in progress surfaces as a short stall instead of an
+immediate error.  Appends carry a per-call txn id the server dedups
+on, which is what makes retrying them safe (a retry of an append that
+actually landed returns the original index instead of double-
+appending)."""
 
 from __future__ import annotations
 
 import json
+import random
 import time
 import uuid
 from typing import List, Optional, Tuple
@@ -20,13 +33,15 @@ class OptimisticRejected(Exception):
 
 
 class EpochChanged(RegionError):
-    """The region log server's boot epoch changed since this client
-    last saw it: the log may have regressed (a crash lost unsynced
-    acked entries, or an older WAL was restored), so locally-applied
-    state can no longer be trusted as a prefix of the log.  Handlers
-    must resync from the log's truth (adopt_epoch() + snapshot +
-    tail); broad RegionError handlers that merely retry keep seeing
-    this raised until someone adopts the new epoch."""
+    """The region log server's epoch changed since this client last
+    saw it: the log may have regressed (a crash lost unsynced acked
+    entries, or an operator restored an older WAL and — as the restore
+    procedure requires — booted it with --rotate_epoch) or a mirror
+    was promoted to primary, so locally-applied state can no longer be
+    trusted as a prefix of the log.  Handlers must resync from the log's truth
+    (adopt_epoch() + snapshot + tail); broad RegionError handlers that
+    merely retry keep seeing this raised until someone adopts the new
+    epoch."""
 
 
 class SnapshotRequired(RegionError):
@@ -37,30 +52,130 @@ class SnapshotRequired(RegionError):
 class RegionClient:
     def __init__(
         self,
-        base_url: str,
+        base_url,
         instance_id: Optional[str] = None,
         *,
         auth_token: Optional[str] = None,
         lease_ttl_s: float = 10.0,
         acquire_timeout_s: float = 10.0,
         http_timeout_s: float = 5.0,
+        retry_deadline_s: float = 3.0,
+        max_retries: int = 4,
     ):
-        self.base = base_url.rstrip("/")
+        if isinstance(base_url, (list, tuple)):
+            urls = [str(u) for u in base_url]
+        else:
+            urls = str(base_url).split(",")
+        self._urls = [u.strip().rstrip("/") for u in urls if u.strip()]
+        if not self._urls:
+            raise ValueError("RegionClient needs at least one URL")
+        self._active = 0
         self.instance_id = instance_id or f"dss-{uuid.uuid4()}"
         self.lease_ttl_s = lease_ttl_s
         self.acquire_timeout_s = acquire_timeout_s
         self._timeout = http_timeout_s
+        self._retry_deadline_s = retry_deadline_s
+        self._max_retries = max_retries
         self._session = requests.Session()
         if auth_token:
             self._session.headers["Authorization"] = f"Bearer {auth_token}"
-        # last ADOPTED server boot epoch vs last SEEN on the wire:
+        # failover/retry observability (coordinator.stats -> /metrics)
+        self.failovers = 0
+        self.transport_retries = 0
+        # last ADOPTED server epoch vs last SEEN on the wire:
         # a mismatch raises EpochChanged until a resync site adopts
         self._epoch: Optional[str] = None
         self._seen_epoch: Optional[str] = None
 
+    @property
+    def base(self) -> str:
+        """The endpoint requests currently target (moves on failover)."""
+        return self._urls[self._active]
+
+    @property
+    def endpoints(self) -> List[str]:
+        return list(self._urls)
+
+    # -- transport: bounded jittered retry + endpoint failover --------------
+
+    def _next_endpoint(self, hint: Optional[str], tried: set) -> None:
+        """Move to the server-hinted primary when it is fresh, else the
+        next endpoint not yet tried during this call.  Hints outside
+        the CONFIGURED list are ignored: a mirror left on its default
+        loopback --advertise_url would otherwise permanently poison
+        the rotation with a URL that is local to the wrong host."""
+        if hint:
+            hint = str(hint).rstrip("/")
+            if hint in self._urls and hint not in tried:
+                self._active = self._urls.index(hint)
+                return
+        n = len(self._urls)
+        for k in range(1, n + 1):
+            cand = (self._active + k) % n
+            if self._urls[cand] not in tried:
+                self._active = cand
+                return
+        self._active = (self._active + 1) % n
+
+    def _request(self, method: str, path: str, *, timeout=None, **kw):
+        """One HTTP call; retries transport failures (connection
+        errors, any 5xx, 503 not-primary) with jittered backoff and
+        endpoint rotation, bounded by max_retries AND a wall-clock
+        deadline (which never cuts off an endpoint's FIRST attempt —
+        a hung primary must not spend the budget mirrors need).
+        Returns any response with status < 500 — semantic
+        statuses (200/401/404/409) are the caller's business.  Raises
+        RegionError once the retry budget is spent."""
+        deadline = time.monotonic() + self._retry_deadline_s
+        attempts = max(self._max_retries, len(self._urls))
+        tried: set = set()
+        last = "unreachable"
+        for attempt in range(attempts + 1):
+            url = self._urls[self._active]
+            hint = None
+            try:
+                r = self._session.request(
+                    method, url + path, timeout=timeout or self._timeout,
+                    **kw,
+                )
+            except requests.RequestException as e:
+                last = f"{url}: {e}"
+                r = None
+            if r is not None:
+                if r.status_code < 500:
+                    return r
+                body = self._json(r)
+                hint = body.get("primary")
+                last = (
+                    f"{url}: {r.status_code} "
+                    f"{body.get('error', '')}".strip()
+                )
+            if attempt >= attempts:
+                break
+            tried.add(url)
+            if time.monotonic() >= deadline and len(tried) >= len(
+                self._urls
+            ):
+                # the wall clock bounds RETRIES, not first attempts: a
+                # hung (partitioned, not refusing) primary eats a full
+                # http timeout, which can exceed the whole deadline —
+                # every configured endpoint still gets one shot before
+                # giving up, or multi-URL failover would never fire on
+                # exactly the failure it exists for
+                break
+            before = self._active
+            self._next_endpoint(hint, tried)
+            self.transport_retries += 1
+            if self._active != before:
+                self.failovers += 1
+            time.sleep(
+                min(0.05 * (2 ** attempt), 0.5) * (0.5 + random.random())
+            )
+        raise RegionError(f"region log {method} {path} failed: {last}")
+
     def _check_epoch(self, body: dict) -> None:
-        """Raise EpochChanged when the server's boot epoch moved off
-        the adopted one.  Pre-epoch servers (no field) are tolerated —
+        """Raise EpochChanged when the server's epoch moved off the
+        adopted one.  Pre-epoch servers (no field) are tolerated —
         the mixed-version stance this client takes elsewhere."""
         ep = body.get("epoch")
         if ep is None:
@@ -107,17 +222,13 @@ class RegionClient:
         deadline = time.monotonic() + self.acquire_timeout_s
         delay = 0.005
         while True:
-            try:
-                r = self._session.post(
-                    f"{self.base}/lease",
-                    json={
-                        "holder": self.instance_id,
-                        "ttl_s": self.lease_ttl_s,
-                    },
-                    timeout=self._timeout,
-                )
-            except requests.RequestException as e:
-                raise RegionError(f"region log unreachable: {e}") from e
+            r = self._request(
+                "POST", "/lease",
+                json={
+                    "holder": self.instance_id,
+                    "ttl_s": self.lease_ttl_s,
+                },
+            )
             if r.status_code == 200:
                 body = self._json(r)
                 token = self._field(body, "token", int, "lease")
@@ -146,12 +257,8 @@ class RegionClient:
 
     def release_lease(self, token: int) -> None:
         try:
-            self._session.delete(
-                f"{self.base}/lease",
-                json={"token": token},
-                timeout=self._timeout,
-            )
-        except requests.RequestException:
+            self._request("DELETE", "/lease", json={"token": token})
+        except RegionError:
             pass  # lease expires on its own TTL
 
     def append(
@@ -161,23 +268,22 @@ class RegionClient:
         index.  release=True drops the lease in the same round trip.
         Raises RegionError if the lease was fenced (caller must
         converge via rollback + tail)."""
-        try:
-            r = self._session.post(
-                f"{self.base}/append",
-                json={
-                    "token": token,
-                    "records": records,
-                    "release": release,
-                    # epoch the lease was granted under: a reborn
-                    # server resets its lease counter, so an integer
-                    # token can collide across epochs — the server
-                    # refuses a mismatched epoch before anything lands
-                    "epoch": self._epoch,
-                },
-                timeout=self._timeout,
-            )
-        except requests.RequestException as e:
-            raise RegionError(f"region append failed: {e}") from e
+        r = self._request(
+            "POST", "/append",
+            json={
+                "token": token,
+                "records": records,
+                "release": release,
+                # epoch the lease was granted under: a reborn
+                # server resets its lease counter, so an integer
+                # token can collide across epochs — the server
+                # refuses a mismatched epoch before anything lands
+                "epoch": self._epoch,
+                # idempotency key: a transport retry of an append
+                # that landed returns the original index
+                "txn": uuid.uuid4().hex,
+            },
+        )
         if r.status_code != 200:
             raise RegionError(f"region append fenced: {r.text}")
         body = self._json(r)
@@ -200,25 +306,22 @@ class RegionClient:
         OptimisticRejected when the server turns it down (conflict /
         lease held / behind compaction) — the caller rolls back and
         retries via the lease path; RegionError on network failures
-        (append MAY have landed)."""
-        try:
-            r = self._session.post(
-                f"{self.base}/append_optimistic",
-                json={
-                    "expected_head": expected_head,
-                    "records": records,
-                    "cells": sorted(int(c) for c in cells),
-                    # the epoch our validation basis came from: a
-                    # reborn (possibly regressed) log must refuse the
-                    # append outright — its history may differ below
-                    # expected_head, so the footprint check alone is
-                    # not a sound basis across epochs
-                    "epoch": self._epoch,
-                },
-                timeout=self._timeout,
-            )
-        except requests.RequestException as e:
-            raise RegionError(f"optimistic append failed: {e}") from e
+        (the txn id lets the transport layer retry those safely)."""
+        r = self._request(
+            "POST", "/append_optimistic",
+            json={
+                "expected_head": expected_head,
+                "records": records,
+                "cells": sorted(int(c) for c in cells),
+                # the epoch our validation basis came from: a
+                # reborn (possibly regressed) log must refuse the
+                # append outright — its history may differ below
+                # expected_head, so the footprint check alone is
+                # not a sound basis across epochs
+                "epoch": self._epoch,
+                "txn": uuid.uuid4().hex,
+            },
+        )
         if r.status_code == 409:
             body = self._json(r)
             raise OptimisticRejected(
@@ -237,14 +340,9 @@ class RegionClient:
     ) -> Tuple[List[Tuple[int, List[dict]]], int]:
         """-> ([(entry_index, [record, ...]), ...], head).  Raises
         SnapshotRequired when from_index predates log compaction."""
-        try:
-            r = self._session.get(
-                f"{self.base}/records",
-                params={"from": from_index},
-                timeout=self._timeout,
-            )
-        except requests.RequestException as e:
-            raise RegionError(f"region fetch failed: {e}") from e
+        r = self._request(
+            "GET", "/records", params={"from": from_index}
+        )
         body = self._json(r)
         self._check_epoch(body)
         if r.status_code == 409 and body.get("snapshot_required"):
@@ -267,12 +365,7 @@ class RegionClient:
 
     def get_snapshot(self) -> Optional[Tuple[int, dict]]:
         """-> (entry_index, state) of the latest snapshot, or None."""
-        try:
-            r = self._session.get(
-                f"{self.base}/snapshot", timeout=self._timeout
-            )
-        except requests.RequestException as e:
-            raise RegionError(f"region snapshot fetch failed: {e}") from e
+        r = self._request("GET", "/snapshot")
         if r.status_code == 404:
             return None
         if r.status_code != 200:
@@ -303,13 +396,10 @@ class RegionClient:
                 {"index": index, "epoch": self._epoch, "state": state},
                 separators=(",", ":"),
             ).encode()
-        try:
-            r = self._session.post(
-                f"{self.base}/snapshot",
-                data=body,
-                headers={"Content-Type": "application/json"},
-                timeout=max(self._timeout, 30.0),
-            )
-        except requests.RequestException as e:
-            raise RegionError(f"region snapshot upload failed: {e}") from e
+        r = self._request(
+            "POST", "/snapshot",
+            data=body,
+            headers={"Content-Type": "application/json"},
+            timeout=max(self._timeout, 30.0),
+        )
         return r.status_code == 200

@@ -146,6 +146,12 @@ class RegionCoordinator:
             "region_rollbacks": self._rollbacks,
             "region_optimistic_commits": self._opt_commits,
             "region_optimistic_conflicts": self._opt_conflicts,
+            # transport-level failover/retry counters (client-side view
+            # of mirror failovers and region hiccups)
+            "region_failovers": getattr(self._client, "failovers", 0),
+            "region_client_retries": getattr(
+                self._client, "transport_retries", 0
+            ),
         }
 
     # -- write-through transaction -------------------------------------------

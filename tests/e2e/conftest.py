@@ -26,10 +26,6 @@ from pathlib import Path
 import pytest
 import requests
 
-pytest.importorskip("cryptography")
-from cryptography.hazmat.primitives import serialization
-from cryptography.hazmat.primitives.asymmetric import rsa
-
 REPO = Path(__file__).resolve().parents[2]
 AUD = "localhost"
 STARTUP_DEADLINE_S = 60.0
@@ -80,6 +76,12 @@ class Proc:
 
 @pytest.fixture(scope="session")
 def certs(tmp_path_factory):
+    # scoped here, not module-level: e2e tests that drive unauthed
+    # processes (the region failover suite) still run without it
+    pytest.importorskip("cryptography")
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+
     d = tmp_path_factory.mktemp("certs")
     key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
     (d / "oauth.key").write_bytes(

@@ -1,0 +1,266 @@
+"""Kill-the-primary failover e2e (the ISSUE 2 acceptance run): a
+replicated region — primary + two mirrors, every one a real OS process
+of the deployable binary — takes quorum=2 writes, loses the primary to
+SIGKILL mid-traffic, promotes the most-caught-up mirror through the
+`--promote` CLI, and proves the replication contract end to end:
+
+  - zero acked writes lost (every quorum-acked entry is on the new
+    primary, byte-for-byte, at its original index);
+  - the promotion bumped the PERSISTED epoch generation;
+  - the multi-URL RegionClient fails over automatically and resumes
+    committing, as does a full DSS instance riding the coordinator;
+  - the dead primary, restarted on its own WAL, is FENCED: it can
+    never ack a write again (quorum unreachable — its mirrors moved
+    on), and re-mirroring it on a fresh WAL converges it to the new
+    primary's log.
+
+The in-process tier of the same machinery (quorum math, epoch rules,
+catch-up, stale-primary rejection) lives in tests/test_region_mirror.py.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import time
+import uuid
+
+import requests
+
+from dss_tpu.region.client import EpochChanged, RegionClient, RegionError
+from dss_tpu.region.log_server import epoch_gen
+from tests.e2e.conftest import REPO, Proc, free_port, wait_healthy
+from tests.e2e.test_blackbox import isa_params
+
+DEADLINE_S = 30.0
+
+
+def wait_until(fn, deadline_s=DEADLINE_S, what="condition"):
+    t0 = time.monotonic()
+    while True:
+        v = fn()
+        if v is not None:
+            return v
+        if time.monotonic() - t0 > deadline_s:
+            raise AssertionError(f"{what} not reached in {deadline_s}s")
+        time.sleep(0.05)
+
+
+def region_proc(port, wal, *, quorum=None, mirror_of=None, what="region"):
+    argv = [
+        "dss_tpu.cmds.region_server",
+        "--addr", f":{port}",
+        "--wal_path", str(wal),
+        "--repl_timeout", "2.0",
+    ]
+    if quorum is not None:
+        argv += ["--quorum", str(quorum)]
+    if mirror_of is not None:
+        argv += ["--mirror_of", mirror_of]
+    p = Proc(argv, what)
+    wait_healthy(f"http://127.0.0.1:{port}/healthy", p.p, what)
+    return p
+
+
+def status(url):
+    return requests.get(f"{url}/status", timeout=5).json()
+
+
+def test_kill_primary_promote_mirror_no_acked_write_lost(tmp_path_factory):
+    d = tmp_path_factory.mktemp("failover")
+    pp, mp1, mp2 = free_port(), free_port(), free_port()
+    p_url = f"http://127.0.0.1:{pp}"
+    m_urls = [f"http://127.0.0.1:{mp1}", f"http://127.0.0.1:{mp2}"]
+
+    procs = []
+    instance = None
+    try:
+        primary = region_proc(
+            pp, d / "p.wal", quorum=2, what="region-primary"
+        )
+        procs.append(primary)
+        # mirrors also carry --quorum 2: it is what they will ENFORCE
+        # once promoted (a failed-over region keeps its durability bar)
+        for port, wal, what in (
+            (mp1, d / "m1.wal", "region-mirror-1"),
+            (mp2, d / "m2.wal", "region-mirror-2"),
+        ):
+            procs.append(
+                region_proc(port, wal, quorum=2, mirror_of=p_url, what=what)
+            )
+
+        # a DSS instance joined through the full endpoint list rides
+        # the same failover at the coordinator tier
+        iport = free_port()
+        instance = Proc(
+            [
+                "dss_tpu.cmds.server",
+                "--addr", f":{iport}",
+                "--storage", "memory",
+                "--region_url", ",".join([p_url] + m_urls),
+                "--region_poll_interval", "0.02",
+                "--instance_id", "failover-dss",
+                "--insecure_no_auth",
+                "--no_warmup",
+            ],
+            "failover-dss",
+        )
+        ibase = f"http://127.0.0.1:{iport}"
+        wait_healthy(f"{ibase}/healthy", instance.p, "failover-dss")
+
+        isa1 = str(uuid.uuid4())
+        r = requests.put(
+            f"{ibase}/v1/dss/identification_service_areas/{isa1}",
+            json=isa_params(lat=48.7),
+            timeout=30,
+        )
+        assert r.status_code == 200, r.text
+
+        # -- traffic: every ack is recorded; the server must never
+        # lose one past this point ------------------------------------
+        writer = RegionClient(
+            [p_url] + m_urls, "e2e-writer",
+            retry_deadline_s=2.0, max_retries=3, acquire_timeout_s=5.0,
+        )
+        acked = {}  # entry index -> payload i
+
+        def try_write(i):
+            try:
+                tok, _ = writer.acquire_lease()
+                idx = writer.append(
+                    tok, [{"t": "traffic", "i": i}], release=True
+                )
+                acked[idx] = i
+                return True
+            except EpochChanged:
+                writer.adopt_epoch()
+                return None
+            except RegionError:
+                return None
+
+        for i in range(8):
+            wait_until(lambda i=i: try_write(i), what=f"write {i}")
+        old_epoch = writer._epoch
+        assert old_epoch is not None
+
+        # -- SIGKILL the primary mid-traffic ---------------------------
+        primary.p.kill()
+        primary.p.wait(timeout=10)
+        # in-flight/new writes fail while there is no primary; none of
+        # these may land as acks
+        for i in range(100, 103):
+            assert try_write(i) is None
+
+        # -- promote the most-caught-up mirror (the runbook) -----------
+        heads = {u: status(u)["head"] for u in m_urls}
+        new_primary = max(m_urls, key=lambda u: heads[u])
+        other = next(u for u in m_urls if u != new_primary)
+        # quorum=2 acks guarantee the max-head survivor holds EVERY
+        # acked entry — the zero-loss core of the acceptance criteria
+        assert heads[new_primary] >= max(acked) + 1
+
+        out = subprocess.run(
+            [
+                sys.executable, "-m", "dss_tpu.cmds.region_server",
+                "--promote",
+                "--addr", f":{new_primary.rsplit(':', 1)[1]}",
+            ],
+            cwd=REPO, capture_output=True, timeout=30,
+        )
+        assert out.returncode == 0, out.stdout + out.stderr
+        st = status(new_primary)
+        assert st["role"] == "primary"
+        assert epoch_gen(st["epoch"]) == epoch_gen(old_epoch) + 1
+        r = requests.post(
+            f"{other}/repoint", json={"primary": new_primary}, timeout=5
+        )
+        assert r.status_code == 200, r.text
+
+        # -- clients fail over and commits resume ----------------------
+        for i in range(8, 12):
+            wait_until(lambda i=i: try_write(i), what=f"post-failover {i}")
+        assert writer.base == new_primary
+        assert writer.failovers >= 1
+
+        # ZERO acked writes lost: every acked index holds its exact
+        # payload on the new primary
+        probe = RegionClient(new_primary, "e2e-probe")
+        entries, head = probe.fetch(0)
+        by_idx = {idx: recs for idx, recs in entries}
+        for idx, i in sorted(acked.items()):
+            assert by_idx.get(idx) == [{"t": "traffic", "i": i}], (
+                f"acked entry {idx} (payload {i}) lost or rewritten"
+            )
+        assert not any(
+            rec.get("i", 0) >= 100
+            for recs in by_idx.values() for rec in recs
+            if rec.get("t") == "traffic"
+        ), "an unacked write from the dead window leaked into the log"
+
+        # the DSS instance (coordinator tier) resyncs to the new epoch
+        # and resumes committing; the pre-failover ISA survived
+        isa2 = str(uuid.uuid4())
+        def instance_write():
+            r = requests.put(
+                f"{ibase}/v1/dss/identification_service_areas/{isa2}",
+                json=isa_params(lat=49.9),
+                timeout=30,
+            )
+            return True if r.status_code == 200 else None
+        wait_until(instance_write, what="instance write after failover")
+        r = requests.get(
+            f"{ibase}/v1/dss/identification_service_areas/{isa1}",
+            timeout=5,
+        )
+        assert r.status_code == 200, r.text
+
+        # -- the dead primary returns... and is fenced -----------------
+        # A supervisor restarts it AS A PRIMARY on its own WAL.  The
+        # SIGKILL left no clean-shutdown marker, so boot rotates the
+        # epoch — and a replicated primary (quorum>=2) that booted
+        # through a recovery rotation refuses primacy outright until
+        # an operator confirms it: no write can ever be acked, no
+        # push can wipe a mirror.  Split-brain becomes unavailability
+        # on the stale side, not divergence.
+        zombie = region_proc(
+            pp, d / "p.wal", quorum=2, what="region-zombie"
+        )
+        procs.append(zombie)
+        zst = status(p_url)
+        assert zst["role"] == "demoted" and zst["diverged"], zst
+        pinned = RegionClient(
+            p_url, "e2e-zombie-writer",
+            retry_deadline_s=1.0, max_retries=1, acquire_timeout_s=3.0,
+        )
+        try:
+            tok, _ = pinned.acquire_lease()
+            pinned.append(tok, [{"t": "fenced"}], release=True)
+            raise AssertionError("stale primary acked a write")
+        except RegionError:
+            pass
+        zombie.stop()
+
+        # -- re-mirror the old primary (runbook final step): fresh WAL,
+        # --mirror_of the new primary; it converges to the region log
+        remirrored = region_proc(
+            pp, d / "p2.wal", mirror_of=new_primary,
+            what="region-remirrored",
+        )
+        procs.append(remirrored)
+        want_head = status(new_primary)["head"]
+        wait_until(
+            lambda: (
+                status(p_url)["head"] >= want_head
+                and status(p_url)["epoch"] == st["epoch"]
+            ) or None,
+            what="re-mirrored ex-primary catch-up",
+        )
+        entries, _ = RegionClient(p_url, "e2e-probe2").fetch(0)
+        assert not any(
+            rec.get("t") == "fenced" for _, recs in entries for rec in recs
+        ), "the fenced write escaped into the region's history"
+    finally:
+        if instance is not None:
+            instance.stop()
+        for p in procs:
+            p.stop()

@@ -42,8 +42,13 @@ def _exported_metric_names() -> set:
     # region coordinator gauges
     names |= {
         "region_applied", "region_dirty", "region_resyncs",
-        "region_rollbacks",
+        "region_rollbacks", "region_failovers", "region_client_retries",
     }
+    # region log server (primary/mirror) metrics — the exported-name
+    # tuple lives next to the code that renders them
+    from dss_tpu.region.mirror import REGION_SERVER_METRICS
+
+    names |= set(REGION_SERVER_METRICS)
     # follower + replica gauges (stats key sets are stable)
     from dss_tpu.parallel.replica import CLASSES
 

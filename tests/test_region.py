@@ -46,9 +46,9 @@ VISIBILITY_DEADLINE_S = 15.0
 class RegionServerThread:
     """Run the region log app on a background event loop; real sockets."""
 
-    def __init__(self, wal_path=None, auth_token=None, port=0):
+    def __init__(self, wal_path=None, auth_token=None, port=0, **kw):
         self._loop = asyncio.new_event_loop()
-        self._app = build_region_app(wal_path, auth_token=auth_token)
+        self._app = build_region_app(wal_path, auth_token=auth_token, **kw)
         self._started = threading.Event()
         self.port = None
         self._want_port = port  # 0 = ephemeral; fixed for restarts
@@ -56,6 +56,11 @@ class RegionServerThread:
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
         assert self._started.wait(10), "region server failed to start"
+        node = self._app.get("region_node")
+        if node is not None and node.advertise_url is None:
+            # ephemeral port: only known now.  Without it a primary
+            # later repointed into a mirror cannot register itself.
+            node.advertise_url = self.url
 
     @property
     def url(self) -> str:
@@ -980,11 +985,23 @@ def test_log_regression_triggers_resync(tmp_path):
         server.stop()
 
 
+def _crash_wal(path):
+    """Strip the clean-shutdown marker (and any trailing blank) from a
+    stopped server's WAL — the on-disk shape a SIGKILL leaves, which
+    boot must treat as 'acked entries may be lost' (epoch rotates)."""
+    with open(path, "rb") as f:
+        lines = f.readlines()
+    while lines and (b'"__clean__"' in lines[-1] or not lines[-1].strip()):
+        lines.pop()
+    with open(path, "wb") as f:
+        f.writelines(lines)
+
+
 def test_epoch_wire_contract(tmp_path):
     """The epoch fence at the client/server seam: a client that tailed
     epoch A must (a) raise EpochChanged on the first fetch against a
-    reborn server, (b) keep raising until adopt_epoch, (c) have its
-    stale-epoch optimistic appends and lease appends refused
+    crash-reborn server, (b) keep raising until adopt_epoch, (c) have
+    its stale-epoch optimistic appends and lease appends refused
     server-side BEFORE anything lands."""
     from dss_tpu.region.client import (
         EpochChanged,
@@ -1001,8 +1018,10 @@ def test_epoch_wire_contract(tmp_path):
     entries, head = c.fetch(0)
     assert head == 1 and len(entries) == 1
 
-    # reborn server, same WAL, same port -> new epoch
+    # CRASH-reborn server (no clean-shutdown marker), same WAL, same
+    # port -> boot cannot prove no acked entry was lost -> new epoch
     server.stop()
+    _crash_wal(wal)
     server = RegionServerThread(wal_path=wal, port=port)
     try:
         with pytest.raises(EpochChanged):
@@ -1025,4 +1044,54 @@ def test_epoch_wire_contract(tmp_path):
         entries, head = c.fetch(0)
         assert head == 1 and entries[0][1][0]["t"] == "x"
     finally:
+        server.stop()
+
+
+def test_clean_restart_keeps_epoch_no_resync(tmp_path):
+    """ADVICE r5 (persisted epoch): a CLEAN log-server restart keeps
+    the epoch — no fleet-wide writer fence, no snapshot+tail resync
+    storm.  The epoch rotates only on recovery rotation (crash/torn
+    tail) or promotion."""
+    wal = str(tmp_path / "region.wal")
+    server = RegionServerThread(wal_path=wal)
+    port = server.port
+    store = make_instance(server.url, "dss-clean")
+    try:
+        svc = RIDService(store.rid, store.clock)
+        isa1 = str(uuid.uuid4())
+        svc.create_isa(
+            isa1,
+            {"extents": rid_extents(), "flights_url": "https://u.e/1"},
+            "uss1",
+        )
+        epoch_before = store.region._client._seen_epoch
+        assert epoch_before is not None
+        base_resyncs = store.region.stats()["region_resyncs"]
+
+        server.stop()  # clean: appends the shutdown marker
+        server = RegionServerThread(wal_path=wal, port=port)
+
+        # a post-restart write commits against the SAME epoch with
+        # zero resyncs (the client's bounded transport retry rides out
+        # the restart gap)
+        def write_ok():
+            try:
+                svc.create_isa(
+                    str(uuid.uuid4()),
+                    {
+                        "extents": rid_extents(lat=37.2),
+                        "flights_url": "https://u.e/2",
+                    },
+                    "uss1",
+                )
+                return True
+            except errors.StatusError:
+                return None  # restart gap: retry
+
+        wait_until(write_ok)
+        assert store.region._client._seen_epoch == epoch_before
+        assert store.region.stats()["region_resyncs"] == base_resyncs
+        assert store.rid.get_isa(isa1) is not None
+    finally:
+        store.close()
         server.stop()

@@ -1,0 +1,839 @@
+"""Region log replication: quorum-acked mirrors, catch-up, promotion,
+fencing, and the persisted epoch (ISSUE 2 tentpole).
+
+In-process integration shape: primary + mirror region log servers run
+as real aiohttp apps on background loops talking over localhost HTTP
+(tests/test_region.py's RegionServerThread); RegionLog/RegionNode unit
+tests drive the quorum and epoch machinery directly.  The OS-process
+kill-the-primary e2e lives in tests/e2e/test_failover.py.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import socket
+import time
+import uuid
+
+import pytest
+
+from dss_tpu.region.client import (
+    EpochChanged,
+    RegionClient,
+    RegionError,
+    SnapshotRequired,
+)
+from dss_tpu.region.log_server import RegionLog, epoch_gen
+from dss_tpu.region.mirror import RegionNode, _MirrorPeer
+from tests.test_region import RegionServerThread, _crash_wal, wait_until
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def start_mirror(primary_url, wal_path=None, auth_token=None, **kw):
+    port = free_port()
+    return RegionServerThread(
+        wal_path=wal_path,
+        auth_token=auth_token,
+        port=port,
+        mirror_of=primary_url,
+        advertise_url=f"http://127.0.0.1:{port}",
+        **kw,
+    )
+
+
+def wait_head(url, want, deadline_s=15.0, token=None):
+    c = RegionClient(url, f"probe-{uuid.uuid4()}", auth_token=token)
+    wait_until(
+        lambda: (c.fetch(0)[1] >= want) or None, deadline_s=deadline_s
+    )
+    return c
+
+
+# -- unit: quorum math -------------------------------------------------------
+
+
+def test_quorum_commit_math():
+    async def run():
+        log = RegionLog(None)
+        node = RegionNode(log, quorum=3, repl_timeout_s=0.5)
+        m1 = _MirrorPeer("http://a", 0, epoch=log.epoch)
+        m2 = _MirrorPeer("http://b", 0, epoch=log.epoch)
+        node.mirrors = {m.url: m for m in (m1, m2)}
+
+        # quorum 3 = primary + 2 mirror acks; one ack is not enough
+        task = asyncio.ensure_future(node.commit(5))
+        await asyncio.sleep(0.02)
+        m1.acked_head = 6
+        node._on_ack(m1)
+        await asyncio.sleep(0.02)
+        assert not task.done()
+        m2.acked_head = 7
+        node._on_ack(m2)
+        assert await task is True
+
+        # already-acked fast path: both mirrors are past idx 4
+        assert await node.commit(4) is True
+
+        # an ack BELOW the entry does not count
+        task = asyncio.ensure_future(node.commit(9))
+        await asyncio.sleep(0.02)
+        m1.acked_head = 9  # == idx: entry 9 itself not yet applied
+        node._on_ack(m1)
+        await asyncio.sleep(0.02)
+        assert not task.done()
+        task.cancel()
+
+        # timeout -> quorum failure counted
+        assert await node.commit(50) is False
+        assert node.quorum_failures == 1
+
+        # quorum 1: immediate, single-node behavior
+        node.quorum = 1
+        assert await node.commit(99) is True
+
+        # demotion mid-wait fails the waiter: never ack demoted
+        node.quorum = 3
+        task = asyncio.ensure_future(node.commit(60))
+        await asyncio.sleep(0.02)
+        node._demote(None)
+        assert await task is False
+        assert node.role == "demoted"
+
+    asyncio.run(run())
+
+
+def test_quorum_ignores_stale_epoch_mirrors():
+    """A peer on another epoch (a repointed ex-primary whose diverged
+    log has not been reset yet) may heartbeat an INFLATED head; its
+    ack must not satisfy quorum — it does not hold our entries."""
+    async def run():
+        log = RegionLog(None)
+        node = RegionNode(log, quorum=2, repl_timeout_s=0.2)
+        stale = _MirrorPeer("http://stale", 8, epoch="0.otherlineage")
+        node.mirrors = {stale.url: stale}
+
+        # fast path: head 8 > idx 3, but the epoch differs -> no ack
+        assert await node.commit(3) is False
+        assert node.quorum_failures == 1
+
+        # waiter path: _on_ack from a stale peer is ignored too
+        task = asyncio.ensure_future(node.commit(3))
+        await asyncio.sleep(0.02)
+        node._on_ack(stale)
+        await asyncio.sleep(0.02)
+        assert not task.done()
+        # once the peer is on our epoch (first successful push), the
+        # same head counts
+        stale.epoch = log.epoch
+        node._on_ack(stale)
+        assert await task is True
+
+    asyncio.run(run())
+
+
+def test_heartbeat_ack_resolves_commit_waiter():
+    """A push can land while its response is lost; the mirror's next
+    heartbeat then carries the first proof the entry is durable there.
+    That heartbeat must resolve commit() waiters — not leave the
+    writer to eat the full replication timeout and a spurious 503."""
+    async def run():
+        log = RegionLog(None)
+        tok = log.acquire("w", 5.0)
+        log.append(tok, [{"t": "x"}])
+        node = RegionNode(log, quorum=2, repl_timeout_s=5.0)
+        task = asyncio.ensure_future(node.commit(0))
+        await asyncio.sleep(0.02)
+        assert not task.done()
+        node.register_mirror("http://m", 1, epoch=log.epoch)
+        assert await asyncio.wait_for(task, 1.0) is True
+
+    asyncio.run(run())
+
+
+def test_regressed_primary_cannot_wipe_ahead_mirror():
+    """fsync-off crash + auto-restart: the reborn primary's recovery
+    rotation outranks every mirror, but its log REGRESSED.  A mirror
+    whose head extends past the pusher's must refuse the epoch
+    adoption (it may hold the only surviving copies of acked entries)
+    instead of wiping itself."""
+    import json as _json
+
+    async def run():
+        log = RegionLog(None, mirror=True)
+        log.adopt_epoch("1.aaaa")
+        for i in range(3):
+            assert log.apply_replicated(i, [{"i": i}], None) == i + 1
+        node = RegionNode(log, mirror_of="http://old")
+        lock = asyncio.Lock()
+
+        # regressed pusher (newer gen, head 1 < our 3): refused, log kept
+        resp = await node.handle_replicate(
+            {"epoch": "2.bbbb", "head": 1, "entries": []}, "2.bbbb", lock
+        )
+        assert resp.status == 409
+        assert _json.loads(resp.text)["error"] == "diverged_ahead"
+        assert log.head == 3 and log.epoch == "1.aaaa"
+
+        # a covering newer primary (head >= ours) IS adopted: reset +
+        # resync is the normal detected-divergence path
+        resp = await node.handle_replicate(
+            {"epoch": "2.bbbb", "head": 3, "entries": []}, "2.bbbb", lock
+        )
+        assert resp.status == 200
+        assert log.epoch == "2.bbbb" and log.head == 0
+
+    asyncio.run(run())
+
+
+def test_divergence_reset_blocks_reads_until_caught_up():
+    """Between the wipe and the snapshot+tail landing, a reset mirror
+    is an empty stub — it must keep refusing reads (diverged) or a
+    failing-over instance would resync to 'the region is empty'."""
+    async def run():
+        log = RegionLog(None, mirror=True)
+        log.adopt_epoch("1.aaaa")
+        for i in range(2):
+            log.apply_replicated(i, [{"i": i}], None)
+        node = RegionNode(log, mirror_of="http://p")
+        lock = asyncio.Lock()
+
+        # covering newer primary at head 3: wipe + adopt, but NOT yet
+        # readable — our head (0) is far from the primary's (3)
+        resp = await node.handle_replicate(
+            {"epoch": "2.bbbb", "head": 3, "entries": []}, "2.bbbb", lock
+        )
+        assert resp.status == 200 and log.head == 0
+        assert node.diverged, "empty stub must not serve reads"
+
+        # entries stream in; reads stay blocked until head covers the
+        # primary's pushed head
+        resp = await node.handle_replicate(
+            {"epoch": "2.bbbb", "head": 3,
+             "entries": [[0, [{"i": 0}], None, None],
+                         [1, [{"i": 1}], None, None]]},
+            "2.bbbb", lock,
+        )
+        assert resp.status == 200 and node.diverged
+        resp = await node.handle_replicate(
+            {"epoch": "2.bbbb", "head": 3,
+             "entries": [[2, [{"i": 2}], None, None]]},
+            "2.bbbb", lock,
+        )
+        assert resp.status == 200 and log.head == 3
+        assert not node.diverged  # caught up: reads may resume
+
+    asyncio.run(run())
+
+
+def test_regressed_reregister_revokes_pending_acks():
+    """quorum=3: mirror A acks entry 10, crashes losing its unsynced
+    tail, and re-registers at a lower head while the commit is still
+    waiting; its stale ack must be revoked or the entry is 'quorum
+    acked' with too few durable copies."""
+    async def run():
+        log = RegionLog(None)
+        node = RegionNode(log, quorum=3, repl_timeout_s=0.5)
+        a = _MirrorPeer("http://a", 0, epoch=log.epoch)
+        b = _MirrorPeer("http://b", 0, epoch=log.epoch)
+        node.mirrors = {m.url: m for m in (a, b)}
+        task = asyncio.ensure_future(node.commit(10))
+        await asyncio.sleep(0.02)
+        a.acked_head = 11
+        node._on_ack(a)  # 1 of 2 needed
+        # A crashes and re-registers with a REGRESSED head
+        node.register_mirror("http://a", 5, epoch=log.epoch)
+        b.acked_head = 11
+        node._on_ack(b)  # still only 1 VALID ack
+        await asyncio.sleep(0.02)
+        assert not task.done(), "revoked ack still counted toward quorum"
+        a.acked_head = 11
+        node._on_ack(a)
+        assert await task is True
+
+    asyncio.run(run())
+
+
+def test_dead_mirrors_pruned_without_heartbeats():
+    """With the only mirror dead, nothing calls register_mirror — the
+    prune must run from commit()/render_metrics() anyway, or
+    region_mirror_count stays inflated and the under-provisioned
+    alert never fires."""
+    import time as _time
+
+    from dss_tpu.region import mirror as mirror_mod
+
+    async def run():
+        log = RegionLog(None)
+        node = RegionNode(log, quorum=2, repl_timeout_s=0.1)
+        m = _MirrorPeer("http://dead", 0, epoch=log.epoch)
+        m.last_seen = _time.monotonic() - mirror_mod.PRUNE_AFTER_S - 1
+        node.mirrors = {m.url: m}
+        assert "region_mirror_count 0.0" in node.render_metrics()
+        assert node.mirrors == {}
+
+    asyncio.run(run())
+    """Promoting a demoted ex-primary (the last-resort runbook move
+    when the new primary also died) must clear the diverged read
+    block: the operator just declared this log the region's truth."""
+    async def run():
+        log = RegionLog(None)
+        node = RegionNode(log, quorum=2)
+        node._demote(None)
+        assert node.role == "demoted" and node.diverged
+        out = await node.promote()
+        assert out["role"] == "primary"
+        assert node.role == "primary" and not node.diverged
+
+    asyncio.run(run())
+
+
+# -- unit: persisted epoch rules --------------------------------------------
+
+
+def test_epoch_persistence_rules(tmp_path):
+    wal = str(tmp_path / "r.wal")
+
+    # fresh log: generation 1, nonce minted
+    log = RegionLog(wal)
+    e1 = log.epoch
+    assert epoch_gen(e1) == 1
+    tok = log.acquire("w", 5.0)
+    log.append(tok, [{"t": "x"}])
+    log.close()
+
+    # clean restart: SAME epoch (the satellite's core pin)
+    log = RegionLog(wal)
+    assert log.epoch == e1
+    assert log.head == 1
+    log.close()
+
+    # crash (no clean marker): rotation — acked entries may be lost
+    _crash_wal(wal)
+    log = RegionLog(wal)
+    assert epoch_gen(log.epoch) == 2 and log.epoch != e1
+    e2 = log.epoch
+
+    # promotion rotation is explicit and survives a clean restart
+    log.rotate_epoch()
+    assert epoch_gen(log.epoch) == 3
+    e3 = log.epoch
+    log.close()
+    log = RegionLog(wal)
+    assert log.epoch == e3 and log.epoch != e2
+    log.close()
+
+
+def test_boot_stamp_defeats_stale_clean_marker(tmp_path):
+    """fsync off: a power loss can wipe a run's ENTIRE unsynced tail.
+    Without a boot stamp, the PREVIOUS run's clean marker would then
+    still sit at the WAL tail and the regression would masquerade as
+    a clean shutdown (epoch kept, readers never fenced)."""
+    import os as _os
+
+    wal = str(tmp_path / "r.wal")
+    log = RegionLog(wal)
+    e1 = log.epoch
+    tok = log.acquire("w", 5.0)
+    log.append(tok, [{"t": "a"}])
+    log.close()  # clean marker at the tail
+
+    log = RegionLog(wal)  # clean restart: epoch kept, boot stamp synced
+    assert log.epoch == e1
+    stamp_size = _os.path.getsize(wal)
+    tok = log.acquire("w", 5.0)
+    log.append(tok, [{"t": "b"}])  # acked, unsynced
+    log._wal._fh.flush()
+    # power loss: everything after the fsynced boot stamp vanishes
+    with open(wal, "r+b") as f:
+        f.truncate(stamp_size)
+    log = RegionLog(wal)
+    assert log.epoch != e1  # regression DETECTED: readers resync
+    assert log.head == 1
+
+
+def test_unclean_replicated_primary_boots_demoted(tmp_path):
+    """quorum>=2: a primary that boots through a recovery rotation
+    refuses primacy (role=demoted) until an operator confirms it —
+    a supervisor crash-loop must never mint generations that displace
+    a real promotion or wipe mirrors holding acked entries.  quorum=1
+    keeps today's single-node auto-resume."""
+    wal = str(tmp_path / "r.wal")
+    log = RegionLog(wal)
+    tok = log.acquire("w", 5.0)
+    log.append(tok, [{"t": "a"}])
+    log.close()
+
+    # clean restart: primacy resumes seamlessly (rolling restarts)
+    log = RegionLog(wal)
+    assert RegionNode(log, quorum=2).role == "primary"
+    log.close()
+
+    _crash_wal(wal)
+    log = RegionLog(wal)
+    node = RegionNode(log, quorum=2)
+    assert node.role == "demoted" and node.diverged
+    # the operator's confirmation path works: promote restores primacy
+    asyncio.run(node.promote())
+    assert node.role == "primary" and not node.diverged
+    log.close()
+
+    # quorum=1 single-node: unchanged auto-resume after a crash
+    _crash_wal(wal)
+    log = RegionLog(wal)
+    assert RegionNode(log, quorum=1).role == "primary"
+    log.close()
+
+    # a FRESH log (first boot ever) is not a recovery: primary
+    log2 = RegionLog(str(tmp_path / "fresh.wal"))
+    assert RegionNode(log2, quorum=2).role == "primary"
+    log2.close()
+
+
+def test_failover_tries_every_endpoint_despite_deadline():
+    """A hung (partitioned, not refusing) endpoint eats a full http
+    timeout, which can exceed the retry deadline; the client must
+    still give every configured endpoint one attempt or multi-URL
+    failover never fires on exactly the failure it exists for."""
+    import socket as _socket
+    import threading
+
+    hung = _socket.socket()
+    hung.bind(("127.0.0.1", 0))
+    hung.listen(8)  # accepts connections, never responds
+    hung_url = f"http://127.0.0.1:{hung.getsockname()[1]}"
+    server = RegionServerThread()
+    try:
+        c = RegionClient(
+            [hung_url, server.url], "fo",
+            http_timeout_s=0.5, retry_deadline_s=0.2, max_retries=3,
+        )
+        entries, head = c.fetch(0)  # hang exceeds the whole deadline
+        assert head == 0 and c.base == server.url
+        assert c.failovers >= 1
+    finally:
+        server.stop()
+        hung.close()
+
+
+def test_force_rotate_for_restored_backups(tmp_path):
+    """--rotate_epoch: a WAL restored from a CLEANLY-shut-down backup
+    carries a valid clean marker, so boot alone keeps the epoch; the
+    restore procedure passes force_rotate to fence readers of the
+    suffix the restore lost."""
+    wal = str(tmp_path / "r.wal")
+    log = RegionLog(wal)
+    e1 = log.epoch
+    log.close()
+    log = RegionLog(wal, force_rotate=True)
+    assert log.epoch != e1 and epoch_gen(log.epoch) == 2
+    log.close()
+
+
+def test_epoch_rotates_on_torn_tail(tmp_path):
+    wal = str(tmp_path / "r.wal")
+    log = RegionLog(wal)
+    e1 = log.epoch
+    tok = log.acquire("w", 5.0)
+    log.append(tok, [{"t": "x"}])
+    log.close()
+    # torn final record (crash mid-append): recovery truncates AND
+    # rotates even though a stale clean marker sits mid-log
+    with open(wal, "ab") as f:
+        f.write(b'{"seq": 99, "t": "__entry__", "recs"')
+    log = RegionLog(wal)
+    assert log.epoch != e1
+    assert log.head == 1  # the torn record is gone, the good one isn't
+    log.close()
+
+
+def test_mirror_log_never_self_rotates(tmp_path):
+    wal = str(tmp_path / "m.wal")
+    log = RegionLog(wal, mirror=True)
+    assert epoch_gen(log.epoch) == 0  # orders below any primary epoch
+    assert log.adopt_epoch("3.abcdef")
+    assert log.epoch == "3.abcdef"
+    log.close()
+    # unclean mirror restart: NO rotation (the primary's epoch is the
+    # authority; a crashed mirror must not leapfrog its generation)
+    _crash_wal(wal)
+    log = RegionLog(wal, mirror=True)
+    assert log.epoch == "3.abcdef"
+    log.close()
+
+
+def test_epoch_survives_compaction(tmp_path):
+    wal = str(tmp_path / "r.wal")
+    log = RegionLog(wal)
+    e1 = log.epoch
+    tok = log.acquire("w", 5.0)
+    for i in range(4):
+        log.append(tok, [{"t": "x", "i": i}])
+        tok = log.acquire("w", 5.0)
+    plan = log.put_snapshot(3, {"s": 1})
+    staging = log.begin_compact(plan)
+    log.finish_compact(staging)
+    log.close()
+    log = RegionLog(wal)
+    assert log.epoch == e1
+    assert log.base == 3 and log.head == 4
+    log.close()
+
+
+def test_txn_dedup_across_retries(tmp_path):
+    wal = str(tmp_path / "r.wal")
+    log = RegionLog(wal)
+    tok = log.acquire("w", 5.0)
+    idx = log.append(tok, [{"t": "x"}], txn_id="t-1")
+    # a transport retry of the same txn returns the SAME index even
+    # after the lease moved on (no double append)
+    log.release(tok)
+    assert log.append(0, [{"t": "x"}], txn_id="t-1") == idx
+    assert log.head == idx + 1
+    st, i2 = log.append_optimistic(log.head, [{"t": "y"}], [7], txn_id="t-2")
+    assert st == "ok"
+    assert log.append_optimistic(0, [{"t": "y"}], [7], txn_id="t-2") == (
+        "ok", i2,
+    )
+    log.close()
+    # dedup memory survives restart (rebuilt from the WAL's txn ids)
+    log = RegionLog(wal)
+    assert log.append(0, [{"t": "x"}], txn_id="t-1") == idx
+    log.close()
+
+
+# -- integration: replication, quorum, catch-up ------------------------------
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    """Primary (quorum=2) + two mirrors, each on its own WAL."""
+    primary = RegionServerThread(
+        wal_path=str(tmp_path / "p.wal"), quorum=2, repl_timeout_s=3.0
+    )
+    m1 = start_mirror(primary.url, wal_path=str(tmp_path / "m1.wal"))
+    m2 = start_mirror(primary.url, wal_path=str(tmp_path / "m2.wal"))
+    yield primary, m1, m2, tmp_path
+    for s in (primary, m1, m2):
+        s.stop()
+
+
+def test_quorum_replication_and_mirror_reads(cluster):
+    primary, m1, m2, _ = cluster
+    c = RegionClient(primary.url, "writer")
+    for i in range(5):
+        tok, _head = c.acquire_lease()
+        assert c.append(tok, [{"t": "e", "i": i}], release=True) == i
+
+    # mirrors serve /records with the full replicated tail + epoch
+    for m in (m1, m2):
+        mc = wait_head(m.url, 5)
+        entries, head = mc.fetch(0)
+        assert head == 5
+        assert [e[1][0]["i"] for e in entries] == list(range(5))
+
+    # epoch is ONE value across the cluster (mirrors adopt primary's)
+    eps = set()
+    for url in (primary.url, m1.url, m2.url):
+        pc = RegionClient(url, "e")
+        pc.fetch(0)
+        eps.add(pc._seen_epoch)
+    assert len(eps) == 1
+
+    # mirrors refuse writes with a not-primary redirect hint
+    import requests
+
+    r = requests.post(
+        f"{m1.url}/lease", json={"holder": "x", "ttl_s": 5.0}, timeout=5
+    )
+    assert r.status_code == 503
+    assert r.json()["not_primary"] and r.json()["primary"] == primary.url
+
+
+def test_quorum_blocks_without_mirrors(tmp_path):
+    """quorum=2 with zero mirrors: appends must NOT be acked."""
+    primary = RegionServerThread(
+        wal_path=str(tmp_path / "p.wal"), quorum=2, repl_timeout_s=0.3
+    )
+    try:
+        c = RegionClient(
+            primary.url, "writer", retry_deadline_s=0.5, max_retries=1
+        )
+        tok, _ = c.acquire_lease()
+        with pytest.raises(RegionError):
+            c.append(tok, [{"t": "e"}], release=True)
+    finally:
+        primary.stop()
+
+
+def test_quorum_two_survives_one_dead_mirror(cluster):
+    primary, m1, m2, _ = cluster
+    c = RegionClient(primary.url, "writer")
+    tok, _ = c.acquire_lease()
+    assert c.append(tok, [{"t": "a"}], release=True) == 0
+    m2.stop()  # one mirror down: quorum 2 of 3 still reachable
+    tok, _ = c.acquire_lease()
+    assert c.append(tok, [{"t": "b"}], release=True) == 1
+    wait_head(m1.url, 2)
+
+
+def test_mirror_late_join_catches_up_across_compaction(tmp_path):
+    """A mirror that joins AFTER the primary compacted must come up
+    through the snapshot+tail path and land on the same head."""
+    primary = RegionServerThread(wal_path=str(tmp_path / "p.wal"))
+    mirror = None
+    try:
+        c = RegionClient(primary.url, "writer")
+        for i in range(8):
+            tok, _ = c.acquire_lease()
+            c.append(tok, [{"t": "e", "i": i}], release=True)
+        assert c.put_snapshot(6, {"compacted": True})
+        with pytest.raises(SnapshotRequired):
+            RegionClient(primary.url, "probe").fetch(0)
+
+        mirror = start_mirror(
+            primary.url, wal_path=str(tmp_path / "m.wal")
+        )
+        mc = RegionClient(mirror.url, "mreader")
+        wait_until(
+            lambda: (
+                mc.get_snapshot() is not None
+                and mc.fetch(6)[1] >= 8
+            ) or None
+        )
+        # snapshot installed + tail applied, and history below the
+        # snapshot is compacted on the mirror too
+        idx, state = mc.get_snapshot()
+        assert idx == 6 and state == {"compacted": True}
+        entries, head = mc.fetch(6)
+        assert head == 8 and [e[0] for e in entries] == [6, 7]
+        with pytest.raises(SnapshotRequired):
+            mc.fetch(0)
+
+        # the mirror's own WAL is durable: restart it, state intact
+        murl = mirror.url
+        mport = mirror.port
+        mirror.stop()
+        mirror = RegionServerThread(
+            wal_path=str(tmp_path / "m.wal"),
+            port=mport,
+            mirror_of=primary.url,
+            advertise_url=murl,
+        )
+        mc2 = RegionClient(mirror.url, "mreader2")
+        entries, head = mc2.fetch(6)
+        assert head == 8
+    finally:
+        primary.stop()
+        if mirror is not None:
+            mirror.stop()
+
+
+def test_rolling_compaction_reaches_mirrors(cluster):
+    primary, m1, m2, _ = cluster
+    c = RegionClient(primary.url, "writer")
+    for i in range(6):
+        tok, _ = c.acquire_lease()
+        c.append(tok, [{"t": "e", "i": i}], release=True)
+    wait_head(m1.url, 6)
+    assert c.put_snapshot(5, {"s": 5})
+    # mirrors adopt the snapshot and compact their own logs
+    for m in (m1, m2):
+        mc = RegionClient(m.url, "probe")
+        wait_until(
+            lambda mc=mc: (mc.get_snapshot() or (0,))[0] == 5 or None
+        )
+        with pytest.raises(SnapshotRequired):
+            mc.fetch(0)
+
+
+# -- integration: promotion, fencing, failover -------------------------------
+
+
+def test_promotion_fences_stale_primary(cluster):
+    """The acceptance-criteria core at the in-process tier: promote a
+    mirror; the old primary's replication stream is rejected
+    (stale-primary append rejection), it demotes itself, clients fail
+    over, and the demoted node's log resets under the new primary."""
+    import requests
+
+    primary, m1, m2, _ = cluster
+    c = RegionClient(
+        [primary.url, m1.url, m2.url], "writer", retry_deadline_s=8.0,
+        max_retries=6,
+    )
+    tok, _ = c.acquire_lease()
+    assert c.append(tok, [{"t": "a"}], release=True) == 0
+    wait_head(m1.url, 1)
+    wait_head(m2.url, 1)
+    old_epoch = c._seen_epoch
+
+    # promote m1; repoint m2 at it (the runbook, no restarts)
+    out = requests.post(f"{m1.url}/promote", json={}, timeout=5).json()
+    assert out["role"] == "primary" and epoch_gen(out["epoch"]) \
+        == epoch_gen(old_epoch) + 1
+    r = requests.post(
+        f"{m2.url}/repoint", json={"primary": m1.url}, timeout=5
+    )
+    assert r.status_code == 200
+
+    # the old primary tries to commit: its push is refused by the
+    # promoted mirror (stale epoch), it demotes itself, the write is
+    # NOT acked
+    stale = RegionClient(
+        primary.url, "stale-writer", retry_deadline_s=0.5, max_retries=1
+    )
+    stale._epoch = old_epoch  # validated under the old epoch
+    tok2, _ = stale.acquire_lease()
+    with pytest.raises(RegionError):
+        stale.append(tok2, [{"t": "lost"}], release=True)
+    wait_until(
+        lambda: (
+            requests.get(f"{primary.url}/status", timeout=5).json()["role"]
+            == "demoted"
+        ) or None
+    )
+    # once demoted, writes get the not-primary redirect
+    r = requests.post(
+        f"{primary.url}/lease", json={"holder": "x", "ttl_s": 5.0},
+        timeout=5,
+    )
+    assert r.status_code == 503 and r.json()["not_primary"]
+
+    # the multi-URL client fails over (503 not-primary -> rotate),
+    # detects the promotion epoch, resyncs, and commits on the new
+    # primary (quorum 2 = m1 + repointed m2)
+    with pytest.raises(EpochChanged):
+        c.fetch(0)
+    c.adopt_epoch()
+    tok3, head = c.acquire_lease()
+    assert c.base == m1.url
+    assert c.append(tok3, [{"t": "b"}], release=True) == head
+    assert c.failovers >= 1
+
+    # the demoted ex-primary, repointed as a mirror, resets to the new
+    # primary's log (divergence reset) and converges
+    r = requests.post(
+        f"{primary.url}/repoint", json={"primary": m1.url}, timeout=5
+    )
+    assert r.status_code == 200
+    # until the new primary's push resets its log, the repointed node
+    # keeps REFUSING reads (diverged flag): its suffix holds "lost",
+    # which the region never acked — serving it would feed readers
+    # history the region does not have.  Raw requests (no client
+    # failover) so we observe THIS node, not the hinted primary.
+    new_epoch = requests.get(f"{m1.url}/status", timeout=5).json()["epoch"]
+
+    def converged():
+        st = requests.get(f"{primary.url}/status", timeout=5).json()
+        r = requests.get(
+            f"{primary.url}/records", params={"from": 0}, timeout=5
+        )
+        if r.status_code == 503:
+            # pre-reset: the diverged log must NOT be readable
+            assert st["diverged"] or st["role"] == "demoted"
+            return None
+        if (
+            st["epoch"] != new_epoch
+            or st["diverged"]
+            or r.json()["head"] < head + 1
+        ):
+            return None
+        return st, r.json()
+
+    (st, body), _ = wait_until(converged)
+    assert [e[1][0]["t"] for e in body["entries"]] == ["a", "b"]  # no "lost"
+    assert st["role"] == "mirror"
+
+
+def test_promote_refuses_behind_min_head(cluster):
+    import requests
+
+    primary, m1, m2, _ = cluster
+    c = RegionClient(primary.url, "writer")
+    tok, _ = c.acquire_lease()
+    c.append(tok, [{"t": "a"}], release=True)
+    wait_head(m1.url, 1)
+    r = requests.post(
+        f"{m1.url}/promote", json={"min_head": 999}, timeout=5
+    )
+    assert r.status_code == 409
+    assert requests.get(
+        f"{m1.url}/status", timeout=5
+    ).json()["role"] == "mirror"
+
+
+def test_client_failover_on_dead_endpoint(cluster):
+    primary, m1, m2, _ = cluster
+    dead = f"http://127.0.0.1:{free_port()}"
+    c = RegionClient([dead, primary.url], "fo", retry_deadline_s=5.0)
+    entries, head = c.fetch(0)  # first endpoint dead -> rotates
+    assert c.failovers >= 1 and c.base == primary.url
+
+
+def test_client_retries_transient_5xx():
+    """Satellite: a transient 5xx burst must be retried with backoff,
+    not surfaced to the coordinator (which would roll back the txn)."""
+    import threading
+
+    from aiohttp import web
+
+    calls = {"n": 0}
+
+    async def flaky_records(request):
+        calls["n"] += 1
+        if calls["n"] <= 2:
+            return web.json_response({"error": "hiccup"}, status=503)
+        return web.json_response({"entries": [], "head": 0, "epoch": "1.x"})
+
+    app = web.Application()
+    app.router.add_get("/records", flaky_records)
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+    holder = {}
+
+    def run():
+        asyncio.set_event_loop(loop)
+        runner = web.AppRunner(app)
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        loop.run_until_complete(site.start())
+        holder["port"] = site._server.sockets[0].getsockname()[1]
+        started.set()
+        loop.run_forever()
+        loop.run_until_complete(runner.cleanup())
+
+    th = threading.Thread(target=run, daemon=True)
+    th.start()
+    assert started.wait(10)
+    try:
+        c = RegionClient(f"http://127.0.0.1:{holder['port']}", "r")
+        entries, head = c.fetch(0)
+        assert head == 0 and calls["n"] == 3
+        assert c.transport_retries == 2
+    finally:
+        loop.call_soon_threadsafe(loop.stop)
+        th.join(timeout=5)
+
+
+def test_region_server_metrics_endpoint(cluster):
+    import requests
+
+    primary, m1, m2, _ = cluster
+    from dss_tpu.region.mirror import REGION_SERVER_METRICS
+
+    for url, is_primary in ((primary.url, 1), (m1.url, 0)):
+        body = requests.get(f"{url}/metrics", timeout=5).text
+        for name in REGION_SERVER_METRICS:
+            assert name in body, (url, name)
+        assert f"region_is_primary {float(is_primary)}" in body
+    h = requests.get(f"{m1.url}/healthy", timeout=5).json()
+    assert h["status"] == "ok" and h["role"] == "mirror"
+    assert "lag_entries" in h
