@@ -112,6 +112,27 @@ def make_parser() -> argparse.ArgumentParser:
         "itself to primary (bumps the persisted epoch, fencing the old "
         "primary), print the result, and exit",
     )
+    p.add_argument(
+        "--tls_cert",
+        default="",
+        help="TLS certificate chain (PEM) — serve HTTPS directly "
+        "(deploy/make_certs.py emits server.crt/server.key; leave "
+        "unset when an ingress/sidecar terminates TLS, as the k8s "
+        "manifests do)",
+    )
+    p.add_argument(
+        "--tls_key",
+        default="",
+        help="TLS private key (PEM); required with --tls_cert",
+    )
+    p.add_argument(
+        "--tls_ca",
+        default="",
+        help="CA bundle (PEM) to trust when the one-shot client verbs "
+        "(--promote) talk to a TLS-serving region server: the request "
+        "goes https:// verified against this CA (make_certs.py emits "
+        "ca.crt).  Without it --promote speaks plaintext http.",
+    )
     return p
 
 
@@ -135,13 +156,22 @@ def build(args) -> web.Application:
 
 
 def send_promote(args) -> int:
-    """POST /promote to the running server at --addr and report."""
+    """POST /promote to the running server at --addr and report.
+    With --tls_ca the request goes https:// verified against that CA
+    (a TLS-serving mirror is unreachable over plaintext)."""
     token = os.environ.get("DSS_REGION_TOKEN", "")
     if not token and args.token_file:
         with open(args.token_file, "r", encoding="utf-8") as fh:
             token = fh.read().strip()
     host, _, port = args.addr.rpartition(":")
-    url = f"http://{host or '127.0.0.1'}:{int(port)}/promote"
+    ctx = None
+    scheme = "http"
+    if args.tls_ca:
+        import ssl
+
+        scheme = "https"
+        ctx = ssl.create_default_context(cafile=args.tls_ca)
+    url = f"{scheme}://{host or 'localhost'}:{int(port)}/promote"
     req = urllib.request.Request(
         url, data=b"{}", method="POST",
         headers={"Content-Type": "application/json"},
@@ -149,7 +179,7 @@ def send_promote(args) -> int:
     if token:
         req.add_header("Authorization", f"Bearer {token}")
     try:
-        with urllib.request.urlopen(req, timeout=10) as resp:
+        with urllib.request.urlopen(req, timeout=10, context=ctx) as resp:
             out = json.loads(resp.read().decode())
     except Exception as e:  # noqa: BLE001 — CLI surface: report + exit code
         print(json.dumps({"error": f"promote failed: {e}"}))
@@ -164,10 +194,15 @@ def main():
     args = make_parser().parse_args()
     if args.promote:
         raise SystemExit(send_promote(args))
+    from dss_tpu.cmds import make_ssl_context
+
+    ssl_ctx = make_ssl_context(args.tls_cert, args.tls_key)
     app = build(args)  # replays the log in RegionLog.__init__
     freeze_boot_heap()
     host, _, port = args.addr.rpartition(":")
-    web.run_app(app, host=host or "0.0.0.0", port=int(port))
+    web.run_app(
+        app, host=host or "0.0.0.0", port=int(port), ssl_context=ssl_ctx
+    )
 
 
 if __name__ == "__main__":

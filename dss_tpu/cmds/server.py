@@ -130,9 +130,12 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--sharded_replica",
         default="",
-        help="'dp,sp' mesh shape: serve a multi-chip ShardedDar read "
-        "replica of SCD operations, refreshed from the WAL (standalone) "
-        "or region log tail, at /aux/v1/replica/operations "
+        help="'dp,sp' mesh shape: serve multi-chip ShardedDar read "
+        "replicas of ALL entity classes (SCD operations + "
+        "subscriptions, RID ISAs + subscriptions), refreshed from the "
+        "WAL (standalone) or region log tail; oversized "
+        "bounded-staleness search batches offload to the mesh, and "
+        "/aux/v1/replica/operations serves the ops class directly "
         "(SURVEY §7 step 7)",
     )
     p.add_argument(
@@ -197,6 +200,18 @@ def make_parser() -> argparse.ArgumentParser:
         help="seconds SIGTERM waits for in-flight requests to complete "
         "before closing connections (reference: GracefulStop, "
         "grpc-backend main.go:217-221)",
+    )
+    p.add_argument(
+        "--tls_cert",
+        default="",
+        help="TLS certificate chain (PEM) — serve HTTPS directly "
+        "(deploy/make_certs.py emits server.crt/server.key; leave "
+        "unset when an ingress/mesh terminates TLS)",
+    )
+    p.add_argument(
+        "--tls_key",
+        default="",
+        help="TLS private key (PEM); required with --tls_cert",
     )
     return p
 
@@ -571,12 +586,19 @@ def main():
 
     args = make_parser().parse_args()
 
+    from dss_tpu.cmds import make_ssl_context
+
+    ssl_ctx = make_ssl_context(args.tls_cert, args.tls_key)
+
     if args.worker_reader:
         _watch_parent()
         app = build(args)
         sock = _public_socket(args.addr, reuse_port=True)
         web.run_app(
-            app, sock=sock, shutdown_timeout=args.shutdown_grace
+            app,
+            sock=sock,
+            shutdown_timeout=args.shutdown_grace,
+            ssl_context=ssl_ctx,
         )
         return
 
@@ -585,6 +607,13 @@ def main():
             raise SystemExit(
                 "--workers is standalone-only (region instances already "
                 "scale horizontally; run more instances instead)"
+            )
+        if ssl_ctx is not None:
+            raise SystemExit(
+                "--tls_cert is single-process only: the worker fleet "
+                "shares one leader loopback that must stay plaintext — "
+                "terminate TLS at the ingress for --workers deployments "
+                "(docs/OPERATIONS.md)"
             )
         if not args.wal_path:
             args.wal_path = os.path.join(
@@ -633,6 +662,7 @@ def main():
         host=host or "0.0.0.0",
         port=int(port),
         shutdown_timeout=args.shutdown_grace,
+        ssl_context=ssl_ctx,
     )
 
 

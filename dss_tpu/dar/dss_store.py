@@ -864,6 +864,8 @@ class DSSStore:
         region_token: Optional[str] = None,
         region_poll_interval_s: float = 0.05,
         region_snapshot_every: int = 512,
+        region_optimistic: bool = True,  # False forces the lease path
+        #                    (bench/diagnosis of lease-path round trips)
         instance_id: Optional[str] = None,
     ):
         if storage == "tpu":
@@ -922,6 +924,7 @@ class DSSStore:
                 self._lock,
                 poll_interval_s=region_poll_interval_s,
                 snapshot_every=region_snapshot_every,
+                optimistic=region_optimistic,
             )
             self.region.bootstrap()
         else:

@@ -29,14 +29,21 @@ an entity live at the time the reader grabbed the state is visible via
 exactly the snapshot or the overlay; an entity updated by a concurrent
 writer is visible as exactly one of its versions.
 
-FOLDING (overlay -> snapshot) runs OFF the write lock: a folder thread
-copies the record list under the lock (O(n) pointer copy), builds the
-packed FastTable aside (the expensive part: pack + HBM upload), then
-swaps under the lock, reconciling the writes that landed mid-fold by
-object identity (they simply stay in the overlay of the new state).
-Folds trigger on overlay overflow (`delta_capacity` postings) and
-opportunistically when the table has been write-idle, so read-heavy
-phases serve from the snapshot path.
+SNAPSHOTS ARE TIERED (dss_tpu.dar.tiers): the published state holds a
+stack of immutable snapshots — a large, rarely-rewritten L0 base plus
+a small L1 delta tier.  A minor FOLD (overlay -> L1) runs OFF the
+write lock: a folder thread copies the writer-tracked delta record set
+under the lock (records newer than L0 — O(delta) pointer copy), builds
+a fresh L1 aside (pack + HBM upload of the DELTA ONLY), then swaps
+under the lock, reconciling the writes that landed mid-fold by object
+identity (they simply stay in the overlay of the new state).  A MAJOR
+compaction (L1 + tombstones -> fresh L0) is the only O(table) rebuild
+and triggers on the churn ratio (tiers.TierPolicy).  Shadowing is
+enforced at write time: updating/removing an entity marks its slot
+dead in every tier holding it live, so the newest tier always wins and
+queries just merge per-tier hits.  Folds trigger on overlay overflow
+(`delta_capacity` postings) and opportunistically when the table has
+been write-idle, so read-heavy phases serve from the snapshot path.
 
 Queries run the batched fused kernel; many concurrent requests are
 micro-batched by dss_tpu.dar.coalesce.QueryCoalescer.
@@ -51,19 +58,17 @@ from typing import Dict, List, NamedTuple, Optional
 import numpy as np
 
 from dss_tpu.dar import budget
+from dss_tpu.dar import tiers as tiersmod
 from dss_tpu.dar.oracle import Record
-from dss_tpu.dar.pack import pack_records, pow2_at_least
+from dss_tpu.dar.pack import pow2_at_least
+from dss_tpu.dar.tiers import EMPTY_SNAPSHOT, Tier, TierSnapshot
 from dss_tpu.ops.conflict import NO_TIME_HI, NO_TIME_LO
 from dss_tpu.ops import fastpath
-from dss_tpu.ops.fastpath import FastTable
 
-
-class _Snapshot(NamedTuple):
-    fast: Optional[FastTable]
-    owner: Optional[np.ndarray]  # i32 per slot
-    ids: List[str]  # slot -> entity_id
-    slot_of: Dict[str, int]  # entity_id -> slot
-    recs: Dict[str, Record]  # id -> Record at build time (immutable)
+# back-compat aliases: the single-snapshot type moved to dar.tiers when
+# it became the per-tier building block
+_Snapshot = TierSnapshot
+_EMPTY_SNAPSHOT = EMPTY_SNAPSHOT
 
 
 class _Overlay(NamedTuple):
@@ -82,14 +87,22 @@ class _Overlay(NamedTuple):
 
 
 class _State(NamedTuple):
-    snap: _Snapshot
+    tiers: "tuple[Tier, ...]"  # oldest (L0) first; () before any fold
     pending: Dict[str, Record]  # overlay source records (immutable)
     overlay: Optional[_Overlay]  # packed form of pending (None if empty)
-    dead: frozenset  # snapshot slots superseded/removed since build
+
+    # back-compat views (bench.py / __graft_entry__ grab the base
+    # FastTable through these)
+    @property
+    def snap(self) -> TierSnapshot:
+        return self.tiers[0].snap if self.tiers else EMPTY_SNAPSHOT
+
+    @property
+    def dead(self) -> frozenset:
+        return self.tiers[0].dead if self.tiers else frozenset()
 
 
-_EMPTY_SNAPSHOT = _Snapshot(None, None, [], {}, {})
-_EMPTY_STATE = _State(_EMPTY_SNAPSHOT, {}, None, frozenset())
+_EMPTY_STATE = _State((), {}, None)
 
 
 def _pack_overlay(pending: Dict[str, Record]) -> Optional[_Overlay]:
@@ -244,11 +257,11 @@ class _PendingQuery:
 
     __slots__ = (
         "st", "b", "qkeys", "alt_lo", "alt_hi", "t_start", "t_end",
-        "now_arr", "owner_ids", "host", "pending",
+        "now_arr", "owner_ids", "tier_host", "tier_pending",
     )
 
     def __init__(self, st, b, qkeys, alt_lo, alt_hi, t_start, t_end,
-                 now_arr, owner_ids, host, pending):
+                 now_arr, owner_ids, tier_host, tier_pending):
         self.st = st
         self.b = b
         self.qkeys = qkeys
@@ -258,15 +271,18 @@ class _PendingQuery:
         self.t_end = t_end
         self.now_arr = now_arr
         self.owner_ids = owner_ids
-        self.host = host  # (qidx, slots) from the exact host path
-        self.pending = pending  # fastpath.PendingBatch (device in flight)
+        # per-tier (aligned with st.tiers): exact host-path hits, or a
+        # fastpath.PendingBatch when that tier went to the device
+        self.tier_host = tier_host  # list of (qidx, slots) | None
+        self.tier_pending = tier_pending  # list of PendingBatch | None
 
     def wait_device(self) -> None:
         """Block until the device results are ready (no data fetch, no
         decode) — lets the pipelined caller time the pure device wait
         separately from the host decode in collect."""
-        if self.pending is not None:
-            self.pending.ready()
+        for p in self.tier_pending:
+            if p is not None:
+                p.ready()
 
 
 class DarTable:
@@ -284,8 +300,21 @@ class DarTable:
         #                               assigned per snapshot build
         idle_fold_s: float = 0.5,  # fold the overlay after this long
         #                            without writes (0 disables)
+        tier_ratio: Optional[float] = None,  # major-compaction churn
+        #                            ratio; None = DSS_TIER_RATIO env
+        #                            (0 disables tiering: every fold is
+        #                            a full rebuild)
+        tier_min_l0: Optional[int] = None,  # L0 sizes below this always
+        #                            compact major; None = env default
     ):
         del max_results, entity_capacity
+        policy = tiersmod.env_policy()
+        self._tier_ratio = (
+            policy.ratio if tier_ratio is None else float(tier_ratio)
+        )
+        self._tier_min_l0 = (
+            policy.min_l0 if tier_min_l0 is None else int(tier_min_l0)
+        )
         self._write_lock = threading.RLock()
         self._rebuild_postings = delta_capacity
         self.records: Dict[str, Record] = {}  # authoritative, writer-owned
@@ -293,6 +322,11 @@ class DarTable:
         # writer-owned overlay index (id -> local idx in the overlay);
         # reset on every fold/rebuild.  Readers never touch it.
         self._overlay_idx: Dict[str, int] = {}
+        # writer-owned delta set: records newer than the L0 base (the
+        # minor-fold source; cleared by major compactions/rebuilds).
+        # Readers never touch it — they see its packed forms (L1 tier +
+        # overlay) through the published state.
+        self._delta: Dict[str, Record] = {}
         # background folding
         self._idle_fold_s = idle_fold_s
         self._gen = 0  # bumped by synchronous rebuilds: abandons folds
@@ -305,6 +339,10 @@ class DarTable:
         self._stats_folds = 0
         self._stats_fold_ms = 0.0
         self._stats_swap_ms = 0.0
+        self._stats_minor_folds = 0
+        self._stats_minor_ms = 0.0
+        self._stats_compactions = 0
+        self._stats_compact_ms = 0.0
 
     # -- write path ----------------------------------------------------------
 
@@ -331,17 +369,18 @@ class DarTable:
         )
         with self._write_lock:
             self.records[entity_id] = rec
+            self._delta[entity_id] = rec
             st = self._state
             pending = dict(st.pending)
             pending[entity_id] = rec
-            slot = st.snap.slot_of.get(entity_id)
-            dead = st.dead if slot is None else st.dead | {slot}
+            # shadow every older tier copy (newest tier wins)
+            tiers = tiersmod.mark_dead(st.tiers, entity_id)
             overlay, idx = _overlay_upsert(
                 st.overlay, rec, self._overlay_idx.get(entity_id)
             )
             self._overlay_idx[entity_id] = idx
-            # one atomic publish: snapshot + overlay + dead set together
-            self._state = _State(st.snap, pending, overlay, dead)
+            # one atomic publish: tiers + overlay + dead sets together
+            self._state = _State(tiers, pending, overlay)
             self._last_write = time.monotonic()
             if len(overlay.key) > self._rebuild_postings:
                 self._request_fold()
@@ -353,6 +392,7 @@ class DarTable:
             rec = self.records.pop(entity_id, None)
             if rec is None:
                 return False
+            self._delta.pop(entity_id, None)
             st = self._state
             pending = st.pending
             overlay = st.overlay
@@ -362,11 +402,10 @@ class DarTable:
                 idx = self._overlay_idx.pop(entity_id, None)
                 if overlay is not None and idx is not None:
                     overlay = _overlay_drop(overlay, idx)
-            slot = st.snap.slot_of.get(entity_id)
-            dead = st.dead if slot is None else st.dead | {slot}
+            tiers = tiersmod.mark_dead(st.tiers, entity_id)
             if self._folding:
                 self._fold_removed.append(entity_id)
-            self._state = _State(st.snap, pending, overlay, dead)
+            self._state = _State(tiers, pending, overlay)
             self._last_write = time.monotonic()
             return True
 
@@ -404,10 +443,22 @@ class DarTable:
                 if triggered:
                     self.fold()
                 else:
-                    # idle compaction: fold a quiet non-empty overlay so
-                    # read-heavy phases serve from the snapshot path
+                    # idle compaction: fold a quiet non-empty overlay
+                    # (or a tier stack whose churn crossed the major
+                    # threshold) so read-heavy phases serve from the
+                    # snapshot path.  has_churn gates the major check:
+                    # without it an empty/small table would wake into a
+                    # guaranteed-no-op fold every idle tick forever
                     st = self._state
-                    if (st.pending or st.dead) and (
+                    has_churn = bool(
+                        self._delta
+                        or len(st.tiers) > 1
+                        or any(t.dead_count for t in st.tiers)
+                    )
+                    if (
+                        st.pending
+                        or (has_churn and self._want_major())
+                    ) and (
                         time.monotonic() - self._last_write
                         >= self._idle_fold_s
                     ):
@@ -417,20 +468,63 @@ class DarTable:
 
                 logging.getLogger("dss.dar").exception("fold failed")
 
-    def fold(self) -> bool:
-        """Fold records into a fresh snapshot OFF the write lock; swap
-        atomically, keeping mid-fold writes in the new overlay.  -> True
-        if a new snapshot was published."""
+    def _want_major(self) -> bool:
+        """Major-compaction trigger: the tier stack's churn (delta
+        records + shadowed rows) crossed the size-ratio threshold, or
+        there is no L0 yet.  Advisory — safe to read without the lock
+        (the fold re-decides under it)."""
+        st = self._state
+        if not st.tiers:
+            return True  # first fold builds the base
+        if self._tier_ratio <= 0:
+            return True  # tiering disabled: every fold is a rebuild
+        l0_n = len(st.tiers[0].snap.ids)
+        if l0_n < self._tier_min_l0:
+            return True  # small tables repack in microseconds
+        churn = len(self._delta) + sum(t.dead_count for t in st.tiers)
+        return churn > self._tier_ratio * max(l0_n, 1)
+
+    def compact(self) -> bool:
+        """Force a major compaction: L1 + tombstones merged into a
+        fresh L0 (off the write lock, like any fold).  -> True if a new
+        snapshot was published."""
+        return self.fold(major=True)
+
+    def fold(self, *, major: Optional[bool] = None) -> bool:
+        """Fold the overlay into the tier stack OFF the write lock and
+        swap atomically, keeping mid-fold writes in the new overlay.
+
+        Minor (the common case): rebuild ONLY the small L1 tier from
+        the writer-tracked delta set — O(overlay + L1), never O(table);
+        the L0 base (and its HBM residency) is untouched.  Major
+        (`major=True`, or the churn-ratio policy): rebuild L0 from all
+        records, clearing the delta set and garbage-collecting every
+        tombstone.  -> True if a new snapshot was published."""
         t_all = time.perf_counter()
         with self._write_lock:
             if self._folding:
                 return False  # a fold is already running
-            if not self._state.pending and not self._state.dead:
-                return False  # nothing new to fold
+            st = self._state
+            if major is None:
+                major = self._want_major()
+            if not st.tiers:
+                major = True  # no base to tier onto yet
+            if major:
+                if (
+                    not st.pending
+                    and not self._delta
+                    and len(st.tiers) <= 1
+                    and not any(t.dead_count for t in st.tiers)
+                ):
+                    return False  # nothing to compact
+                recs = list(self.records.values())  # O(n) pointer copy
+            else:
+                if not st.pending:
+                    return False  # overlay empty; L1 already == delta
+                recs = list(self._delta.values())  # O(delta) copy
             self._folding = True
             self._fold_removed = []
             gen0 = self._gen
-            recs = list(self.records.values())  # O(n) pointer copy
         try:
             snap = self._build_snapshot(recs)  # pack + HBM upload, unlocked
             t_swap = time.perf_counter()
@@ -455,18 +549,41 @@ class DarTable:
                     s = snap.slot_of.get(i)
                     if s is not None:
                         dead.add(s)
+                new_tier = tiersmod.make_tier(snap, dead)
+                if major:
+                    # fresh base: delta keeps only mid-compaction writes
+                    self._delta = {
+                        i: r
+                        for i, r in self._delta.items()
+                        if built.get(i) is not r
+                    }
+                    tiers = (new_tier,) if snap.ids else ()
+                else:
+                    # L0 carries over untouched (mid-fold writes already
+                    # grew its dead set in cur); the fresh L1 — built
+                    # from the FULL delta set — replaces the old one
+                    tiers = (
+                        (cur.tiers[0], new_tier)
+                        if snap.ids
+                        else (cur.tiers[0],)
+                    )
                 overlay = _pack_overlay(new_pending)
                 self._overlay_idx = {
                     i: k for k, i in enumerate(new_pending)
                 }
-                self._state = _State(
-                    snap, new_pending, overlay, frozenset(dead)
-                )
+                self._state = _State(tiers, new_pending, overlay)
                 self._stats_swap_ms += (
                     time.perf_counter() - t_swap
                 ) * 1000
+            dur_ms = (time.perf_counter() - t_all) * 1000
             self._stats_folds += 1
-            self._stats_fold_ms += (time.perf_counter() - t_all) * 1000
+            self._stats_fold_ms += dur_ms
+            if major:
+                self._stats_compactions += 1
+                self._stats_compact_ms += dur_ms
+            else:
+                self._stats_minor_folds += 1
+                self._stats_minor_ms += dur_ms
             return True
         finally:
             with self._write_lock:
@@ -475,47 +592,21 @@ class DarTable:
 
     @staticmethod
     def _build_snapshot(live: List[Record]) -> _Snapshot:
-        if not live:
-            return _EMPTY_SNAPSHOT
-        packed = pack_records(live, pad_postings=False)
-        pe = packed.post_ent
-        ft = FastTable(
-            packed.post_key,
-            pe,
-            packed.alt_lo[pe],
-            packed.alt_hi[pe],
-            packed.t_start[pe],
-            packed.t_end[pe],
-            packed.active[pe],
-            slot_exact={
-                "alt_lo": packed.alt_lo,
-                "alt_hi": packed.alt_hi,
-                "t0": packed.t_start,
-                "t1": packed.t_end,
-                "live": packed.active.copy(),
-            },
-        )
-        ids = [r.entity_id for r in live]
-        return _Snapshot(
-            fast=ft,
-            owner=packed.owner,
-            ids=ids,
-            slot_of={eid: i for i, eid in enumerate(ids)},
-            recs={r.entity_id: r for r in live},
-        )
+        return tiersmod.build_snapshot(live)
 
     def _rebuild_locked(self):
         """Synchronous in-lock rebuild (bulk loads / explicit calls).
         Bumps the generation so any in-flight background fold abandons
         its (now stale) snapshot instead of swapping it in."""
         self._gen += 1
+        snap = self._build_snapshot(list(self.records.values()))
         self._state = _State(
-            self._build_snapshot(list(self.records.values())),
+            (tiersmod.make_tier(snap),) if snap.ids else (),
             {},
             None,
-            frozenset(),
         )
         self._overlay_idx = {}
+        self._delta = {}
 
     def rebuild(self):
         with self._write_lock:
@@ -598,25 +689,33 @@ class DarTable:
         if dup.any():
             qkeys[:, 1:][dup] = -1
 
-        host = None
-        pending = None
-        if st.snap.fast is not None:
-            # small batches answer from the host postings copy (exact,
-            # native C++ when built) instead of paying a device round
-            # trip; big batches amortize the trip and win on the device
-            host = st.snap.fast.query_host_auto(
+        # per-tier answers, host path first: small batches answer from
+        # each tier's host postings copy (exact, native C++ when built)
+        # instead of paying a device round trip — the tiny L1 tier
+        # almost always stays on the host even when L0 needs the device
+        tier_host: List = []
+        need_device: List[int] = []
+        for ti, tier in enumerate(st.tiers):
+            if tier.snap.fast is None:
+                tier_host.append(None)
+                continue
+            host = tier.snap.fast.query_host_auto(
                 qkeys, alt_lo, alt_hi, t_start, t_end, now=now_arr
             )
+            tier_host.append(host)
             if host is None:
-                if budget.is_host_only():
-                    # caller is on the event loop: re-run via executor
-                    raise budget.NeedsDevice()
-                pending = st.snap.fast.submit(
-                    qkeys, alt_lo, alt_hi, t_start, t_end, now=now_arr
-                )
+                need_device.append(ti)
+        if need_device and budget.is_host_only():
+            # caller is on the event loop: re-run via executor
+            raise budget.NeedsDevice()
+        tier_pending: List = [None] * len(st.tiers)
+        for ti in need_device:
+            tier_pending[ti] = st.tiers[ti].snap.fast.submit(
+                qkeys, alt_lo, alt_hi, t_start, t_end, now=now_arr
+            )
         return _PendingQuery(
             st, b, qkeys, alt_lo, alt_hi, t_start, t_end, now_arr,
-            owner_ids, host, pending,
+            owner_ids, tier_host, tier_pending,
         )
 
     def query_many_collect(self, pq: Optional[_PendingQuery]) -> List[List[str]]:
@@ -629,23 +728,26 @@ class DarTable:
             return []
         st = pq.st
         out_sets = [set() for _ in range(pq.b)]
-        if st.snap.fast is not None:
-            if pq.host is not None:
-                qidx, slots = pq.host
+        for tier, host, pending in zip(
+            st.tiers, pq.tier_host, pq.tier_pending
+        ):
+            if tier.snap.fast is None:
+                continue
+            if host is not None:
+                qidx, slots = host
             else:
-                qidx, slots = st.snap.fast.collect(pq.pending)
+                qidx, slots = tier.snap.fast.collect(pending)
             if len(qidx):
-                if st.dead:
-                    keep = ~np.isin(
-                        slots, np.fromiter(st.dead, np.int64, len(st.dead))
-                    )
-                    qidx, slots = qidx[keep], slots[keep]
+                # per-tier shadowing: slots superseded by a newer tier
+                # (or the overlay) were marked dead at write/fold time,
+                # so dropping them here makes the newest tier win
+                qidx, slots = tiersmod.filter_dead(tier, qidx, slots)
                 if pq.owner_ids is not None and len(qidx):
                     keep = (pq.owner_ids[qidx] < 0) | (
-                        st.snap.owner[slots] == pq.owner_ids[qidx]
+                        tier.snap.owner[slots] == pq.owner_ids[qidx]
                     )
                     qidx, slots = qidx[keep], slots[keep]
-            _scatter_hits(out_sets, qidx, slots, st.snap.ids)
+            _scatter_hits(out_sets, qidx, slots, tier.snap.ids)
 
         if st.overlay is not None:
             oq, oent = _overlay_search(
@@ -654,9 +756,10 @@ class DarTable:
             )
             _scatter_hits(out_sets, oq, oent, st.overlay.ids)
 
-        # an entity updated since the snapshot build appears via the
-        # overlay only (its old slot is in st.dead); sets dedup any
-        # transient double-sighting.  Sorted for deterministic responses.
+        # an entity updated since a tier was built appears via a newer
+        # tier or the overlay only (its old slot is in that tier's dead
+        # set); sets dedup any transient double-sighting.  Sorted for
+        # deterministic responses.
         return [sorted(s) for s in out_sets]
 
     def query_many(
@@ -707,7 +810,9 @@ class DarTable:
         )[0]
         counts = {int(k): 0 for k in qk}
         for eid in ids:
-            rec = st.pending.get(eid) or st.snap.recs.get(eid)
+            rec = st.pending.get(eid) or tiersmod.resolve_record(
+                st.tiers, eid
+            )
             if rec is None:
                 continue
             for k in np.intersect1d(rec.keys, qk):
@@ -718,12 +823,28 @@ class DarTable:
 
     def stats(self) -> dict:
         st = self._state
-        return {
+        tier = tiersmod.stats(st.tiers)
+        out = {
             "live_records": len(self.records),
-            "snapshot_records": len(st.snap.ids),
+            # total snapshot rows across tiers (dead rows included,
+            # matching the pre-tier meaning of this gauge)
+            "snapshot_records": (
+                tier["tier_l0_records"] + tier["tier_l1_records"]
+            ),
             "pending_records": len(st.pending),
-            "dead_slots": len(st.dead),
+            "dead_slots": tier["tier_shadowed_rows"],
             "folds": self._stats_folds,
             "fold_ms_total": round(self._stats_fold_ms, 1),
             "fold_swap_ms_total": round(self._stats_swap_ms, 3),
+            # tiered-compaction gauges (dss_dar_<class>_tier_* in
+            # /metrics): tier sizes, shadowed rows, and the minor-fold
+            # vs major-compaction duration split
+            "tier_delta_records": len(self._delta),
+            "tier_minor_folds": self._stats_minor_folds,
+            "tier_minor_fold_ms_total": round(self._stats_minor_ms, 1),
+            "tier_compactions": self._stats_compactions,
+            "tier_compact_ms_total": round(self._stats_compact_ms, 1),
+            "tier_ratio": self._tier_ratio,
         }
+        out.update(tier)
+        return out

@@ -1,0 +1,252 @@
+"""Tiered snapshots: the LSM-style tier stack behind DarTable.
+
+The single-snapshot DarTable paid O(table) per fold: every overlay
+flush repacked ALL records and re-uploaded the whole postings table to
+HBM (fold_ms_mean ~10 s at 1M intents — ~100 s extrapolated at 10M,
+during which the overlay and every query's host-scan cost grow without
+bound).  The reference gets compaction for free from CockroachDB's LSM
+(implementation_details.md:3-8); this module is the equivalent, built
+as a first-class subsystem:
+
+  L0 (base)   — one large, rarely-rewritten snapshot.  Holds every
+                record as of the last MAJOR compaction.
+  L1 (delta)  — one small snapshot absorbing minor folds: all records
+                written/updated since L0 was built.  Rebuilt from the
+                writer-tracked delta set on every fold — O(overlay+L1),
+                never O(table).
+  overlay     — unchanged: records since the last fold, spliced O(Δ)
+                per write (dar/snapshot.py).
+
+Shadowing (newest tier wins) is enforced at WRITE time, not query
+time: updating or removing an entity marks its slot dead in every tier
+that still holds it live, so each visible entity is live in exactly
+one tier (or the overlay) and the query path simply merges per-tier
+hits after per-tier dead filtering.  Tombstones accumulate in the
+per-tier dead sets and are garbage-collected by the next major
+compaction, which rebuilds L0 from the authoritative record dict.
+
+Major compactions (L1 + tombstones merged into a fresh L0) trigger on
+the churn ratio: when |delta records| + |shadowed rows| exceeds
+DSS_TIER_RATIO x |L0| the amortized O(table) rebuild is paid once,
+exactly like an LSM size-ratio trigger.  Why not full LSM levels: a
+DAR serves point/area lookups over a covering index where every extra
+tier costs one more host range-lookup + (possibly) one more device
+window pass per query — two tiers bound that cost while already making
+folds O(delta); more levels would buy lower write amplification this
+workload (bounded by the WAL, not the fold) does not need.
+
+Knobs (env, read at DarTable construction; docs/OPERATIONS.md):
+
+  DSS_TIER_RATIO   — churn ratio triggering a major compaction
+                     (default 0.25; 0 disables tiering: every fold is
+                     a full rebuild, the pre-tier behavior).
+  DSS_TIER_MIN_L0  — below this many L0 records every fold is major
+                     (default 0; small tables repack in microseconds,
+                     so tier bookkeeping can be skipped).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from dss_tpu.dar.oracle import Record
+from dss_tpu.dar.pack import pack_records
+from dss_tpu.ops.fastpath import FastTable
+
+
+class TierSnapshot(NamedTuple):
+    """One immutable device snapshot (the former dar.snapshot._Snapshot,
+    generalized: L0 and L1 are both instances of this)."""
+
+    fast: Optional[FastTable]
+    owner: Optional[np.ndarray]  # i32 per slot
+    ids: List[str]  # slot -> entity_id
+    slot_of: Dict[str, int]  # entity_id -> slot
+    recs: Dict[str, Record]  # id -> Record at build time (immutable)
+
+
+EMPTY_SNAPSHOT = TierSnapshot(None, None, [], {}, {})
+
+
+# shadowed-slot bookkeeping: dead slots live in TWO sorted int64
+# arrays per tier — a small `dead_recent` (grown by O(recent) insert
+# per write) and a large, stable `dead_base`.  When recent crosses
+# this threshold it folds into base (one O(base) union).  This bounds
+# the per-write copy AND the per-query filter cost to O(threshold) no
+# matter how much churn accumulates between major compactions — a
+# single frozenset would degrade both to O(accumulated churn) at 10M
+# scale (dead sets persist until a major compaction now, unlike the
+# pre-tier design where every fold reset them).
+DEAD_FOLD_THRESHOLD = 4096
+
+_EMPTY_I64 = np.zeros(0, np.int64)
+
+
+class Tier(NamedTuple):
+    """One published tier: an immutable snapshot plus the slots
+    superseded/removed since it was built (never mutated — writers
+    publish a replacement Tier with grown dead arrays)."""
+
+    snap: TierSnapshot
+    dead_recent: np.ndarray  # i64 sorted, small (<= threshold-ish)
+    dead_base: np.ndarray  # i64 sorted, stable between threshold folds
+
+    @property
+    def dead(self) -> frozenset:
+        """All shadowed slots (diagnostic/test view — the hot paths
+        use the sorted arrays directly)."""
+        return frozenset(
+            int(s) for s in np.concatenate([self.dead_recent, self.dead_base])
+        )
+
+    @property
+    def dead_count(self) -> int:
+        return len(self.dead_recent) + len(self.dead_base)
+
+
+def make_tier(snap: TierSnapshot, dead_slots=()) -> Tier:
+    """A fresh Tier whose dead set starts as `dead_slots` (mid-fold
+    reconciliation output)."""
+    arr = np.asarray(sorted(dead_slots), np.int64)
+    return Tier(snap, arr, _EMPTY_I64)
+
+
+def _sorted_contains(arr: np.ndarray, v: int) -> bool:
+    i = int(np.searchsorted(arr, v))
+    return i < len(arr) and int(arr[i]) == v
+
+
+def slot_dead(tier: Tier, slot: int) -> bool:
+    return _sorted_contains(tier.dead_recent, slot) or _sorted_contains(
+        tier.dead_base, slot
+    )
+
+
+def filter_dead(tier: Tier, qidx: np.ndarray, slots: np.ndarray):
+    """Drop (qidx, slot) hits whose slot is shadowed in this tier.
+    Both dead arrays are pre-sorted, so membership is a searchsorted
+    pass per array — O(H log D), no per-query set conversion."""
+    keep = None
+    for arr in (tier.dead_recent, tier.dead_base):
+        if not len(arr):
+            continue
+        pos = np.searchsorted(arr, slots)
+        pos[pos == len(arr)] = 0  # any in-range index; compare below
+        hit = arr[pos] == slots
+        keep = ~hit if keep is None else keep & ~hit
+    if keep is None:
+        return qidx, slots
+    return qidx[keep], slots[keep]
+
+
+class TierPolicy(NamedTuple):
+    ratio: float  # major compaction when churn > ratio * |L0|
+    min_l0: int  # L0 sizes below this always compact major
+
+
+def env_policy() -> TierPolicy:
+    """Tier policy from DSS_TIER_* env vars (deployment-level knobs,
+    docs/OPERATIONS.md); unset variables keep the defaults."""
+    try:
+        ratio = float(os.environ.get("DSS_TIER_RATIO", 0.25))
+    except ValueError:
+        raise ValueError(
+            f"DSS_TIER_RATIO={os.environ['DSS_TIER_RATIO']!r} is not a float"
+        )
+    try:
+        min_l0 = int(os.environ.get("DSS_TIER_MIN_L0", 0))
+    except ValueError:
+        raise ValueError(
+            f"DSS_TIER_MIN_L0={os.environ['DSS_TIER_MIN_L0']!r} is not an int"
+        )
+    return TierPolicy(ratio=ratio, min_l0=min_l0)
+
+
+def build_snapshot(live: List[Record]) -> TierSnapshot:
+    """Pack records into one device-resident snapshot (postings +
+    exact attribute columns + host decode state)."""
+    if not live:
+        return EMPTY_SNAPSHOT
+    packed = pack_records(live, pad_postings=False)
+    pe = packed.post_ent
+    ft = FastTable(
+        packed.post_key,
+        pe,
+        packed.alt_lo[pe],
+        packed.alt_hi[pe],
+        packed.t_start[pe],
+        packed.t_end[pe],
+        packed.active[pe],
+        slot_exact={
+            "alt_lo": packed.alt_lo,
+            "alt_hi": packed.alt_hi,
+            "t0": packed.t_start,
+            "t1": packed.t_end,
+            "live": packed.active.copy(),
+        },
+    )
+    ids = [r.entity_id for r in live]
+    return TierSnapshot(
+        fast=ft,
+        owner=packed.owner,
+        ids=ids,
+        slot_of={eid: i for i, eid in enumerate(ids)},
+        recs={r.entity_id: r for r in live},
+    )
+
+
+def mark_dead(tiers: Tuple[Tier, ...], entity_id: str) -> Tuple[Tier, ...]:
+    """Shadow an entity everywhere: mark its slot dead in every tier
+    that still holds it live.  Returns the input tuple unchanged when
+    nothing needed marking (no allocation on the brand-new-entity fast
+    path).  Per-write cost is O(len(dead_recent)) <= O(threshold) — a
+    small sorted insert — never O(accumulated churn); a recent array
+    crossing the threshold folds into the base once (O(base))."""
+    out = None
+    for i, t in enumerate(tiers):
+        s = t.snap.slot_of.get(entity_id)
+        if s is None or slot_dead(t, s):
+            continue
+        recent = np.insert(
+            t.dead_recent, int(np.searchsorted(t.dead_recent, s)), s
+        )
+        base = t.dead_base
+        if len(recent) > DEAD_FOLD_THRESHOLD:
+            # amortized: one O(base) merge per threshold shadowings
+            base = np.union1d(base, recent)
+            recent = _EMPTY_I64
+        if out is None:
+            out = list(tiers)
+        out[i] = Tier(t.snap, recent, base)
+    return tiers if out is None else tuple(out)
+
+
+def resolve_record(
+    tiers: Tuple[Tier, ...], entity_id: str
+) -> Optional[Record]:
+    """The entity's visible record across the tier stack, newest tier
+    first (an id live in two tiers would be a shadowing bug; dead
+    filtering makes the newest copy the only live one)."""
+    for t in reversed(tiers):
+        s = t.snap.slot_of.get(entity_id)
+        if s is not None and not slot_dead(t, s):
+            return t.snap.recs.get(entity_id)
+    return None
+
+
+def stats(tiers: Tuple[Tier, ...]) -> dict:
+    """Gauge-ready tier metrics (flow into /metrics as
+    dss_dar_<class>_tier_* via the index stats)."""
+    l0 = len(tiers[0].snap.ids) if tiers else 0
+    l1 = sum(len(t.snap.ids) for t in tiers[1:])
+    shadowed = sum(t.dead_count for t in tiers)
+    return {
+        "tier_count": len(tiers),
+        "tier_l0_records": l0,
+        "tier_l1_records": l1,
+        "tier_l0_dead": tiers[0].dead_count if tiers else 0,
+        "tier_shadowed_rows": shadowed,
+    }

@@ -6,6 +6,9 @@ Istio; the Go services expose nothing — SURVEY.md §5).  Exposes:
   dss_requests_total{method,route,status}        counter
   dss_request_duration_seconds{method,route}     histogram
   dss_dar_entities / dss_dar_postings / ...      gauges via set_gauge
+  dss_dar_<class>_tier_*                         tiered-snapshot gauges
+      (tier sizes, shadowed rows, minor-fold vs major-compaction
+      counts/durations — DarTable.stats via the index stats)
 
 Route labels are templatized (UUID path segments -> ":id") to bound
 cardinality.  Scrape at GET /metrics.

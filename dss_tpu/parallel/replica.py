@@ -10,10 +10,19 @@ source-of-truth/read-replica split the reference gets from CRDB ranges
 (implementation_details.md:11-42, where range sharding covers EVERY
 table).
 
-Consistency: readers grab ONE (dar, ids) snapshot reference per query,
-so a query always runs against a complete snapshot — concurrent
-refreshes are invisible until their atomic swap.  Staleness is bounded
-by the poll interval + rebuild time and exposed via stats.
+Consistency: readers grab ONE class snapshot reference per query, so a
+query always runs against a complete snapshot — concurrent refreshes
+are invisible until their atomic swap.  Staleness is bounded by the
+poll interval + rebuild time and exposed via stats.
+
+Refreshes ship TIER DELTAS, not full tables (mirroring the DarTable
+tier stack, dss_tpu.dar.tiers): each class keeps a large, rarely
+rebuilt BASE ShardedDar plus a small DELTA ShardedDar holding the
+records written since the base was built, with a shadow set hiding
+base copies superseded or deleted since.  A routine refresh rebuilds
+only the delta dar — O(churn), not O(table) — and a major rebuild
+(full repack) runs only when the churn ratio crosses the same
+DSS_TIER_RATIO policy the DarTable uses.
 
 Sources:
   - `wal_path`: tail a standalone server's WriteAheadLog file
@@ -29,11 +38,12 @@ import logging
 import os
 import threading
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, NamedTuple, Optional, Tuple
 
 import numpy as np
 
 from dss_tpu.dar import codec
+from dss_tpu.dar import tiers as tiersmod
 from dss_tpu.dar.oracle import Record
 from dss_tpu.geo import s2cell
 from dss_tpu.ops.conflict import NO_TIME_HI, NO_TIME_LO
@@ -43,6 +53,22 @@ log = logging.getLogger("dss.replica")
 
 # entity classes the replica serves (replica class name -> WAL prefix)
 CLASSES = ("ops", "isas", "rid_subs", "scd_subs")
+
+
+class _ClsSnap(NamedTuple):
+    """One class's published snapshot: base + delta tier dars.  A base
+    id in `shadow` is superseded (its current version lives in the
+    delta dar) or deleted — queries drop it, so the newest tier wins."""
+
+    base: Optional[ShardedDar]
+    base_ids: List[str]
+    shadow: frozenset  # base entity_ids hidden by newer state
+    delta: Optional[ShardedDar]
+    delta_ids: List[str]
+
+    @property
+    def live_records(self) -> int:
+        return len(self.base_ids) - len(self.shadow) + len(self.delta_ids)
 
 
 class _WalTail:
@@ -211,11 +237,17 @@ class ShardedReplica:
         region_client=None,
         max_results: int = 512,
         warm_batches=(1,),
+        tier_ratio: Optional[float] = None,  # None = DSS_TIER_RATIO env
     ):
         if (wal_path is None) == (region_client is None):
             raise ValueError("exactly one of wal_path / region_client")
         self.mesh = mesh
         self.max_results = max_results
+        self._tier_ratio = (
+            tiersmod.env_policy().ratio
+            if tier_ratio is None
+            else float(tier_ratio)
+        )
         # batch sizes to warm per rebuild: each maps to a pow2 jit
         # bucket; mesh-offload consumers add their min_batch so the
         # first oversized batch after a swap doesn't stall on a compile
@@ -226,6 +258,12 @@ class ShardedReplica:
         self._records: Dict[str, Dict[str, Record]] = {
             c: {} for c in CLASSES
         }
+        # tier bookkeeping per class: ids inside the published base
+        # dar (membership only — the records themselves stay in
+        # self._records), records newer than it, and base ids to hide
+        self._base: Dict[str, set] = {c: set() for c in CLASSES}
+        self._delta: Dict[str, Dict[str, Record]] = {c: {} for c in CLASSES}
+        self._shadow: Dict[str, set] = {c: set() for c in CLASSES}
         self._owners: Dict[str, int] = {}
         self._dirty = {c: False for c in CLASSES}
         self._mu = threading.Lock()  # guards records + tail + rebuild
@@ -233,12 +271,14 @@ class ShardedReplica:
         # build order (the warmup happens outside _mu, so without this
         # a slower older build could overwrite a newer snapshot)
         self._refresh_mu = threading.Lock()
-        self._snapshots: Dict[
-            str, Optional[Tuple[Optional[ShardedDar], List[str]]]
-        ] = {c: None for c in CLASSES}
+        self._snapshots: Dict[str, Optional[_ClsSnap]] = {
+            c: None for c in CLASSES
+        }
         self._applied_records = 0
         self._apply_errors = 0
         self._rebuilds = 0
+        self._delta_refreshes = 0
+        self._major_rebuilds = 0
         self._last_fresh = 0.0  # monotonic time of last caught-up sync
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -293,10 +333,16 @@ class ShardedReplica:
 
     def _put(self, cls: str, rec: Record) -> None:
         self._records[cls][rec.entity_id] = rec
+        if rec.entity_id in self._base[cls]:
+            self._shadow[cls].add(rec.entity_id)  # newer than base
+        self._delta[cls][rec.entity_id] = rec
         self._dirty[cls] = True
 
     def _del(self, cls: str, eid: str) -> None:
         if self._records[cls].pop(eid, None) is not None:
+            self._delta[cls].pop(eid, None)
+            if eid in self._base[cls]:
+                self._shadow[cls].add(eid)
             self._dirty[cls] = True
 
     def _apply_locked(self, rec: dict) -> None:
@@ -321,6 +367,11 @@ class ShardedReplica:
                 fresh["rid_subs"][r.entity_id] = r
             self._records = fresh
             for c in CLASSES:
+                # wholesale replacement invalidates the tier split: the
+                # next refresh of each class is a major rebuild
+                self._base[c] = set()
+                self._delta[c] = {}
+                self._shadow[c] = set()
                 self._dirty[c] = True
         elif t == "scd_op_put":
             self._put("ops", self._rec_from_op_doc(rec["doc"]))
@@ -387,24 +438,65 @@ class ShardedReplica:
         with self._mu:
             if not self._dirty[cls] and self._snapshots[cls] is not None:
                 return False
-            recs = list(self._records[cls].values())
-            ids = [r.entity_id for r in recs]
-            dar = (
-                ShardedDar(recs, self.mesh, max_results=self.max_results)
-                if recs
-                else None
+            prev = self._snapshots[cls]
+            churn = len(self._delta[cls]) + len(self._shadow[cls])
+            major = (
+                prev is None
+                or not self._base[cls]
+                or self._tier_ratio <= 0
+                or churn > self._tier_ratio * max(len(self._base[cls]), 1)
             )
+            if major:
+                # full repack: fresh base tier, tombstones GC'd
+                recs = list(self._records[cls].values())
+                base = (
+                    ShardedDar(
+                        recs, self.mesh, max_results=self.max_results
+                    )
+                    if recs
+                    else None
+                )
+                snap = _ClsSnap(
+                    base=base,
+                    base_ids=[r.entity_id for r in recs],
+                    shadow=frozenset(),
+                    delta=None,
+                    delta_ids=[],
+                )
+                self._base[cls] = set(self._records[cls])
+                self._delta[cls] = {}
+                self._shadow[cls] = set()
+            else:
+                # ship the tier delta only: rebuild the small delta dar
+                # (O(churn)); the base dar and its device residency are
+                # untouched
+                drecs = list(self._delta[cls].values())
+                delta = (
+                    ShardedDar(
+                        drecs, self.mesh, max_results=self.max_results
+                    )
+                    if drecs
+                    else None
+                )
+                snap = _ClsSnap(
+                    base=prev.base,
+                    base_ids=prev.base_ids,
+                    shadow=frozenset(self._shadow[cls]),
+                    delta=delta,
+                    delta_ids=[r.entity_id for r in drecs],
+                )
+            built = snap.delta if not major else snap.base
             # records ingested while we build/warm re-mark dirty and
             # are picked up by the next refresh
             self._dirty[cls] = False
-        # warm the new snapshot's query executable BEFORE publishing:
-        # the jit cache keys on the snapshot's postings-run capacity,
-        # so a rebuild can mean a fresh XLA compile — readers keep
-        # hitting the old snapshot until the warmed one swaps in
-        if dar is not None:
+        # warm the new dar's query executable BEFORE publishing: the
+        # jit cache keys on the snapshot's postings-run capacity, so a
+        # rebuild can mean a fresh XLA compile — readers keep hitting
+        # the old snapshot until the warmed one swaps in
+        if built is not None:
             for wb in self.warm_batches:
                 try:
-                    dar.query_batch(
+                    built.query_batch(
                         np.full((wb, 16), -1, np.int32),
                         np.full(wb, -np.inf, np.float32),
                         np.full(wb, np.inf, np.float32),
@@ -415,8 +507,12 @@ class ShardedReplica:
                 except Exception:  # noqa: BLE001 — warmup best-effort
                     pass
         with self._mu:
-            self._snapshots[cls] = (dar, ids)
+            self._snapshots[cls] = snap
             self._rebuilds += 1
+            if major:
+                self._major_rebuilds += 1
+            else:
+                self._delta_refreshes += 1
         return True
 
     def sync(self) -> None:
@@ -524,12 +620,14 @@ class ShardedReplica:
         now,  # scalar or i64[B]
         cls: str = "ops",
     ) -> List[List[str]]:
-        """Batched mesh query -> entity-id lists (sorted)."""
+        """Batched mesh query -> entity-id lists (sorted).  Hits merge
+        across the base and delta tiers; base ids in the shadow set
+        (superseded/deleted since the base was built) are dropped, so
+        the newest tier wins."""
         snap = self._snapshots[cls]
         b = len(keys_list)
-        if snap is None or snap[0] is None:
+        if snap is None or (snap.base is None and snap.delta is None):
             return [[] for _ in range(b)]
-        dar, ids = snap
         from dss_tpu.dar.pack import pow2_at_least
 
         width = pow2_at_least(
@@ -539,17 +637,28 @@ class ShardedReplica:
         for i, k in enumerate(keys_list):
             u = np.unique(np.asarray(k, np.int32))
             qkeys[i, : len(u)] = u
-        rows = dar.query_batch(
-            qkeys,
-            np.asarray(alt_lo, np.float32),
-            np.asarray(alt_hi, np.float32),
-            np.asarray(t_start, np.int64),
-            np.asarray(t_end, np.int64),
-            now=now,
-        )
-        return [
-            sorted(ids[s] for s in row if s < len(ids)) for row in rows
-        ]
+        out = [set() for _ in range(b)]
+        for dar, ids, drop in (
+            (snap.base, snap.base_ids, snap.shadow),
+            (snap.delta, snap.delta_ids, None),
+        ):
+            if dar is None:
+                continue
+            rows = dar.query_batch(
+                qkeys,
+                np.asarray(alt_lo, np.float32),
+                np.asarray(alt_hi, np.float32),
+                np.asarray(t_start, np.int64),
+                np.asarray(t_end, np.int64),
+                now=now,
+            )
+            for i, row in enumerate(rows):
+                for s in row:
+                    if s < len(ids):
+                        eid = ids[s]
+                        if drop is None or eid not in drop:
+                            out[i].add(eid)
+        return [sorted(s) for s in out]
 
     def stats(self) -> dict:
         out = {
@@ -557,6 +666,8 @@ class ShardedReplica:
             "replica_apply_errors": self._apply_errors,
             "replica_tail_errors": getattr(self._tail, "errors", 0),
             "replica_rebuilds": self._rebuilds,
+            "replica_delta_refreshes": self._delta_refreshes,
+            "replica_major_rebuilds": self._major_rebuilds,
             "replica_staleness_s": (
                 -1.0
                 if self._last_fresh == 0.0
@@ -567,12 +678,19 @@ class ShardedReplica:
             snap = self._snapshots[cls]
             out[f"replica_{cls}_records"] = len(self._records[cls])
             out[f"replica_{cls}_snapshot_records"] = (
-                0 if snap is None else len(snap[1])
+                0 if snap is None else snap.live_records
             )
-            out[f"replica_{cls}_overflow_fallbacks"] = (
-                0
-                if snap is None or snap[0] is None
-                else snap[0].overflow_fallbacks
+            fallbacks = 0
+            if snap is not None:
+                for dar in (snap.base, snap.delta):
+                    if dar is not None:
+                        fallbacks += dar.overflow_fallbacks
+            out[f"replica_{cls}_overflow_fallbacks"] = fallbacks
+            out[f"replica_{cls}_delta_records"] = (
+                0 if snap is None else len(snap.delta_ids)
+            )
+            out[f"replica_{cls}_shadowed"] = (
+                0 if snap is None else len(snap.shadow)
             )
             out[f"replica_{cls}_dirty"] = int(self._dirty[cls])
         return out

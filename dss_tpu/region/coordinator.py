@@ -102,6 +102,17 @@ class RegionCoordinator:
         self._lease_only_until = 0.0
         self._opt_commits = 0
         self._opt_conflicts = 0
+        # per-phase wall time on the write path (ms totals), so the
+        # lease-path overhead is attributable round trip by round trip
+        # (bench_fanout reads the deltas; VERDICT r5 ask #4)
+        self._phase_ms = {
+            "lease": 0.0,
+            "catchup": 0.0,
+            "append": 0.0,
+            "release": 0.0,
+            "opt_append": 0.0,
+        }
+        self._lease_txns = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -151,6 +162,22 @@ class RegionCoordinator:
             "region_failovers": getattr(self._client, "failovers", 0),
             "region_client_retries": getattr(
                 self._client, "transport_retries", 0
+            ),
+            # write-path phase accounting (ms totals; lease-path txns
+            # split into their round trips, optimistic txns into one)
+            "region_txn_lease_count": self._lease_txns,
+            "region_txn_lease_ms_total": round(self._phase_ms["lease"], 3),
+            "region_txn_catchup_ms_total": round(
+                self._phase_ms["catchup"], 3
+            ),
+            "region_txn_append_ms_total": round(
+                self._phase_ms["append"], 3
+            ),
+            "region_txn_release_ms_total": round(
+                self._phase_ms["release"], 3
+            ),
+            "region_txn_opt_append_ms_total": round(
+                self._phase_ms["opt_append"], 3
             ),
         }
 
@@ -233,6 +260,8 @@ class RegionCoordinator:
                     self._commit_optimistic_locked(buf)
                 return
 
+            self._lease_txns += 1
+            t_ph = time.perf_counter()
             try:
                 token, head = self._client.acquire_lease()
             except EpochChanged:
@@ -247,8 +276,13 @@ class RegionCoordinator:
                     raise errors.unavailable(f"region write lease: {e}")
             except RegionError as e:
                 raise errors.unavailable(f"region write lease: {e}")
+            finally:
+                self._phase_ms["lease"] += (
+                    time.perf_counter() - t_ph
+                ) * 1000
             released = False
             try:
+                t_ph = time.perf_counter()
                 try:
                     if head is None or head > self._applied:
                         # behind the log: fetch + apply the gap.  When
@@ -258,6 +292,10 @@ class RegionCoordinator:
                         self._catch_up_locked()
                 except RegionError as e:
                     raise errors.unavailable(f"region catch-up: {e}")
+                finally:
+                    self._phase_ms["catchup"] += (
+                        time.perf_counter() - t_ph
+                    ) * 1000
                 self._depth = 1
                 self._buffer = []
                 try:
@@ -277,7 +315,13 @@ class RegionCoordinator:
                     released = True
             finally:
                 if not released:
-                    self._client.release_lease(token)
+                    t_ph = time.perf_counter()
+                    try:
+                        self._client.release_lease(token)
+                    finally:
+                        self._phase_ms["release"] += (
+                            time.perf_counter() - t_ph
+                        ) * 1000
 
     def _commit_optimistic_locked(self, buf: List[dict]) -> None:
         wire = [
@@ -294,6 +338,7 @@ class RegionCoordinator:
             )
             e.retryable_write_conflict = True
             raise e
+        t_ph = time.perf_counter()
         try:
             idx = self._client.append_optimistic(self._applied, wire, cells)
         except OptimisticRejected as e:
@@ -316,6 +361,10 @@ class RegionCoordinator:
                 f"region append failed; local txn rolled back "
                 f"(re-applied from the log if it landed): {e}"
             )
+        finally:
+            self._phase_ms["opt_append"] += (
+                time.perf_counter() - t_ph
+            ) * 1000
         self._opt_commits += 1
         if idx == self._applied:
             self._applied += 1
@@ -353,6 +402,7 @@ class RegionCoordinator:
         wire = [
             {k: v for k, v in rec.items() if k != "undo"} for rec in buf
         ]
+        t_ph = time.perf_counter()
         try:
             idx = self._client.append(token, wire, release=True)
         except RegionError as e:
@@ -366,6 +416,10 @@ class RegionCoordinator:
                 f"region append failed; local txn rolled back "
                 f"(re-applied from the log if it landed): {e}"
             )
+        finally:
+            self._phase_ms["append"] += (
+                time.perf_counter() - t_ph
+            ) * 1000
         if idx != self._applied:
             # someone slipped between our catch-up and append — the
             # lease should make this impossible.  The batch IS in the

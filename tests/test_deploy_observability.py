@@ -122,6 +122,28 @@ def test_grafana_dashboard_references_real_metrics():
     assert len(dash["panels"]) >= 8
 
 
+def test_grafana_dashboard_has_tier_panels():
+    """The tiered-snapshot subsystem (dar/tiers.py) must stay visible:
+    the dashboard carries panels over the dss_dar_*_tier_* gauges
+    (tier sizes, shadowed rows, minor-fold vs major-compaction time)."""
+    dash = json.load(
+        open(os.path.join(ROOT, "deploy/grafana/dss-dashboard.json"))
+    )
+    exprs = [
+        t["expr"]
+        for p in dash["panels"]
+        for t in p.get("targets", [])
+    ]
+    for needed in (
+        "tier_l0_records",
+        "tier_l1_records",
+        "tier_shadowed_rows",
+        "tier_minor_fold_ms_total",
+        "tier_compact_ms_total",
+    ):
+        assert any(needed in e for e in exprs), needed
+
+
 def test_make_certs_provisions_trust_material(tmp_path):
     """deploy/make_certs.py (the reference's build/make-certs.py +
     apply-certs.sh analog): JWT keypair, region token, TLS CA chain,

@@ -3,12 +3,17 @@ identical under random operation sequences.
 
 The store-contract tests pin known scenarios; this pins a longer tail:
 random interleavings of ISA create/delete, RID search, SCD operation
-put (with per-backend OVN keys)/delete, and SCD search on BOTH
-backends.  Outcomes (success vs exact error status/code), result-id
-sets, and notified-subscriber sets are compared; versions/OVNs are
-per-store commit-timestamp artifacts and are excluded.  The memory
-backend is a direct transliteration of the reference's SQL semantics
-(dar/oracle.py), so agreement here is agreement with the reference."""
+put (with per-backend OVN keys)/delete, and SCD search on THREE
+backends — memory, tpu with aggressive TIERED snapshots (folds forced
+mid-sequence so queries constantly cross the L0/L1/overlay split), and
+tpu with tiering DISABLED (tier_ratio=0: every fold a full rebuild,
+the pre-tier single-snapshot path).  Outcomes (success vs exact error
+status/code), result-id sets, and notified-subscriber sets are
+compared; versions/OVNs are per-store commit-timestamp artifacts and
+are excluded.  The memory backend is a direct transliteration of the
+reference's SQL semantics (dar/oracle.py), so agreement here is
+agreement with the reference — and tiered agreeing with flat pins the
+tiering acceptance criterion (bit-identical results)."""
 
 from __future__ import annotations
 
@@ -69,13 +74,38 @@ def _norm_outcome(fn, *args):
         return ("err", e.http_status, int(e.code))
 
 
+def _index_tables(store):
+    out = []
+    for index in (
+        store.rid._isa_index, store.rid._sub_index,
+        store.scd._op_index, store.scd._sub_index,
+    ):
+        t = getattr(index, "table", None)
+        if t is not None:
+            out.append(t)
+    return out
+
+
 @pytest.mark.parametrize("seed", list(range(1, 9)))
-def test_backends_agree_under_random_ops(seed):
+def test_backends_agree_under_random_ops(seed, monkeypatch):
+    # "tpu": tiering forced aggressive (churn ratio 5 -> folds stay
+    # minor, so the tier stack is live for most of the sequence);
+    # "tpu_flat": tiering disabled (every fold a full single-snapshot
+    # rebuild) — the differential pin that tiered == single-snapshot
+    monkeypatch.setenv("DSS_TIER_RATIO", "5")
+    tiered = DSSStore(storage="tpu")
+    monkeypatch.setenv("DSS_TIER_RATIO", "0")
+    flat = DSSStore(storage="tpu")
+    monkeypatch.delenv("DSS_TIER_RATIO")
     stores = {
-        name: DSSStore(storage=name) for name in ("memory", "tpu")
+        "memory": DSSStore(storage="memory"),
+        "tpu": tiered,
+        "tpu_flat": flat,
     }
+    others = [n for n in stores if n != "memory"]
     rid = {n: RIDService(s.rid, s.clock) for n, s in stores.items()}
     scd = {n: SCDService(s.scd, s.clock) for n, s in stores.items()}
+    max_tiers = 0
 
     rng = np.random.default_rng(seed)
     # versions, like OVNs, derive from per-store commit timestamps:
@@ -235,70 +265,84 @@ def test_backends_agree_under_random_ops(seed):
                 for n in stores
             }
 
-        mem, tpu = outs["memory"], outs["tpu"]
-        assert mem[0] == tpu[0], (step, op, mem, tpu)
+        mem = outs["memory"]
+        for n in others:
+            assert mem[0] == outs[n][0], (step, op, n, mem, outs[n])
         if mem[0] == "err":
-            assert mem[1:] == tpu[1:], (step, op, mem, tpu)
+            for n in others:
+                assert mem[1:] == outs[n][1:], (step, op, n, mem, outs[n])
             continue
-        a, b = mem[1], tpu[1]
+        res = {n: o[1] for n, o in outs.items()}
         # normalize: versions/OVNs derive from per-store commit
         # timestamps and legitimately differ; ids and SETS of results
         # must agree exactly
         if op == 2:
-            ids_a = sorted(s["id"] for s in a["service_areas"])
-            ids_b = sorted(s["id"] for s in b["service_areas"])
-            assert ids_a == ids_b, (step, ids_a, ids_b)
+            ids = {
+                n: sorted(s["id"] for s in r["service_areas"])
+                for n, r in res.items()
+            }
+            for n in others:
+                assert ids[n] == ids["memory"], (step, n, ids)
         elif op == 5:
-            ids_a = sorted(o["id"] for o in a["operation_references"])
-            ids_b = sorted(o["id"] for o in b["operation_references"])
-            assert ids_a == ids_b, (step, ids_a, ids_b)
-        elif op == 0:
-            subs_a = sorted(
-                x["subscriptions"][0]["subscription_id"]
-                for x in a["subscribers"]
-            )
-            subs_b = sorted(
-                x["subscriptions"][0]["subscription_id"]
-                for x in b["subscribers"]
-            )
-            assert subs_a == subs_b, (step, subs_a, subs_b)
-            isa_versions["memory"][a["service_area"]["id"]] = a[
-                "service_area"
-            ]["version"]
-            isa_versions["tpu"][b["service_area"]["id"]] = b[
-                "service_area"
-            ]["version"]
+            ids = {
+                n: sorted(o["id"] for o in r["operation_references"])
+                for n, r in res.items()
+            }
+            for n in others:
+                assert ids[n] == ids["memory"], (step, n, ids)
+        elif op in (0, 8):
+            subs = {
+                n: sorted(
+                    x["subscriptions"][0]["subscription_id"]
+                    for x in r["subscribers"]
+                )
+                for n, r in res.items()
+            }
+            for n in others:
+                assert subs[n] == subs["memory"], (step, n, subs)
+            for n, r in res.items():
+                isa_versions[n][r["service_area"]["id"]] = r[
+                    "service_area"
+                ]["version"]
         elif op == 1:
             for m in isa_versions.values():
                 m.pop(sid, None)
         elif op == 3:
-            op_ovns["memory"][sid] = a["operation_reference"]["ovn"]
-            op_ovns["tpu"][sid] = b["operation_reference"]["ovn"]
+            for n, r in res.items():
+                op_ovns[n][sid] = r["operation_reference"]["ovn"]
         elif op == 4:
             for m in op_ovns.values():
                 m.pop(sid, None)
         elif op == 6:
-            rid_sub_versions["memory"][sid] = a["subscription"]["version"]
-            rid_sub_versions["tpu"][sid] = b["subscription"]["version"]
+            for n, r in res.items():
+                rid_sub_versions[n][sid] = r["subscription"]["version"]
             # affected ISAs returned on sub create must agree
-            ids_a = sorted(x["id"] for x in a.get("service_areas", []))
-            ids_b = sorted(x["id"] for x in b.get("service_areas", []))
-            assert ids_a == ids_b, (step, ids_a, ids_b)
+            ids = {
+                n: sorted(x["id"] for x in r.get("service_areas", []))
+                for n, r in res.items()
+            }
+            for n in others:
+                assert ids[n] == ids["memory"], (step, n, ids)
         elif op == 7:
             for m in rid_sub_versions.values():
                 m.pop(sid, None)
-        elif op == 8:
-            subs_a = sorted(
-                x["subscriptions"][0]["subscription_id"]
-                for x in a["subscribers"]
-            )
-            subs_b = sorted(
-                x["subscriptions"][0]["subscription_id"]
-                for x in b["subscribers"]
-            )
-            assert subs_a == subs_b, (step, subs_a, subs_b)
-            isa_versions["memory"][sid] = a["service_area"]["version"]
-            isa_versions["tpu"][sid] = b["service_area"]["version"]
 
+        if step % 6 == 5:
+            # force folds mid-sequence so later queries cross the tier
+            # split (tiered) and the rebuilt snapshot (flat) — the
+            # overlay-only easy path must not be all the fuzz sees
+            for n in others:
+                for t in _index_tables(stores[n]):
+                    t.fold()
+            max_tiers = max(
+                max_tiers,
+                max(
+                    t.stats()["tier_count"]
+                    for t in _index_tables(stores["tpu"])
+                ),
+            )
+
+    # the tiered backend must actually have served from >= 2 tiers
+    assert max_tiers >= 2, "fuzz never exercised the tier stack"
     for s in stores.values():
         s.close()

@@ -1,0 +1,285 @@
+"""Tiered snapshots (dar/tiers.py + DarTable minor/major folds):
+minor folds rebuild only the L1 delta tier, shadowing across tiers
+(newest wins), tombstone GC at major compaction, mid-compaction writes
+reconciled, generation-abandon on rebuild, and a differential fuzz
+pinning the tiered and single-snapshot paths bit-identical."""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from dss_tpu.dar import tiers as tiersmod
+from dss_tpu.dar.oracle import Record
+from dss_tpu.dar.snapshot import DarTable
+
+
+def _put(t, i, keys, t0=0, t1=10**18, owner=0):
+    t.upsert(
+        f"e{i}", np.asarray(keys, np.int32), None, None, t0, t1, owner
+    )
+
+
+def _q(t, keys, now=1):
+    return t.query(np.asarray(keys, np.int32), now=now)
+
+
+def _table(**kw):
+    kw.setdefault("delta_capacity", 1 << 30)  # no auto-folds
+    kw.setdefault("idle_fold_s", 0)  # no folder daemon
+    return DarTable(**kw)
+
+
+def test_minor_fold_builds_l1_without_touching_l0():
+    t = _table(tier_ratio=10.0)  # churn never crosses: folds stay minor
+    for i in range(50):
+        _put(t, i, [i])
+    assert t.fold()  # first fold is major (builds the base)
+    st = t.stats()
+    assert st["tier_count"] == 1 and st["tier_l0_records"] == 50
+    l0_fast = t._state.tiers[0].snap.fast
+    for i in range(50, 60):
+        _put(t, i, [i])
+    assert t.fold()  # minor: L1 from the 10-record delta
+    st = t.stats()
+    assert st["tier_count"] == 2
+    assert st["tier_l0_records"] == 50 and st["tier_l1_records"] == 10
+    assert st["tier_minor_folds"] == 1 and st["tier_compactions"] == 1
+    # the L0 device snapshot is the SAME object — no repack, no
+    # re-upload (the whole point of the tier split)
+    assert t._state.tiers[0].snap.fast is l0_fast
+    assert _q(t, [5]) == ["e5"]
+    assert _q(t, [55]) == ["e55"]
+    t.close()
+
+
+def test_shadowing_across_tiers_newest_wins():
+    t = _table(tier_ratio=10.0)
+    _put(t, 1, [5, 6])
+    _put(t, 2, [6, 7])
+    t.fold()  # major: both in L0
+    _put(t, 1, [9])  # move e1 -> overlay; L0 slot shadowed
+    assert _q(t, [5]) == []
+    assert _q(t, [9]) == ["e1"]
+    t.fold()  # minor: e1's new version now lives in L1
+    assert t.stats()["tier_count"] == 2
+    assert _q(t, [5]) == []
+    assert _q(t, [6]) == ["e2"]
+    assert _q(t, [9]) == ["e1"]
+    _put(t, 1, [5])  # move again -> overlay; BOTH L0 and L1 copies dead
+    assert _q(t, [9]) == []
+    assert _q(t, [5]) == ["e1"]
+    t.fold()  # minor again: fresh L1 replaces the old one
+    assert _q(t, [9]) == []
+    assert _q(t, [5]) == ["e1"]
+    # remove an entity that lives in a tier: visible nowhere
+    assert t.remove("e1")
+    assert _q(t, [5]) == []
+    t.fold()
+    assert _q(t, [5]) == []
+    t.close()
+
+
+def test_tombstone_gc_at_major_compaction():
+    t = _table(tier_ratio=10.0)
+    for i in range(30):
+        _put(t, i, [i])
+    t.fold()  # major
+    for i in range(10):
+        _put(t, i, [i + 100])  # shadow 10 L0 slots
+    t.fold()  # minor: shadowed rows accumulate
+    for i in range(10, 15):
+        t.remove(f"e{i}")
+    st = t.stats()
+    assert st["tier_shadowed_rows"] == 15  # 10 updated + 5 removed
+    assert st["dead_slots"] == 15
+    assert t.compact()  # major: tombstones GC'd, tiers merged
+    st = t.stats()
+    assert st["tier_count"] == 1
+    assert st["tier_shadowed_rows"] == 0 and st["dead_slots"] == 0
+    assert st["tier_l0_records"] == 25
+    assert _q(t, [105]) == ["e5"]
+    assert _q(t, [12]) == []
+    assert _q(t, [20]) == ["e20"]
+    t.close()
+
+
+def test_mid_compaction_writes_and_removes_reconciled():
+    """Writes racing minor folds AND major compactions must be exactly
+    reflected after each swap (the generation/_fold_removed machinery,
+    now exercised across the tier split)."""
+    t = _table(tier_ratio=10.0)
+    for i in range(300):
+        _put(t, i, [i % 40])
+    stop = threading.Event()
+    wrote = []
+
+    def writer():
+        j = 1000
+        while not stop.is_set():
+            _put(t, j, [j % 40])
+            wrote.append(j)
+            if j % 3 == 0:
+                t.remove(f"e{j}")
+                wrote.pop()
+            j += 1
+
+    th = threading.Thread(target=writer)
+    th.start()
+    try:
+        for k in range(6):
+            # alternate minor folds and major compactions under fire
+            if k % 2:
+                t.compact()
+            else:
+                t.fold()
+    finally:
+        stop.set()
+        th.join()
+    t.fold()
+    for j in wrote[-50:]:
+        assert f"e{j}" in _q(t, [j % 40]), j
+    assert "e1002" not in _q(t, [1002 % 40])
+    assert "e7" in _q(t, [7 % 40])
+    t.close()
+
+
+def test_generation_abandon_on_rebuild():
+    """A synchronous rebuild mid-fold bumps the generation; the fold's
+    (now stale) snapshot must be abandoned, not swapped in."""
+    t = _table(tier_ratio=10.0)
+    for i in range(20):
+        _put(t, i, [i])
+    build_started = threading.Event()
+    release_build = threading.Event()
+    real_build = t._build_snapshot
+
+    def gated_build(recs):
+        build_started.set()
+        assert release_build.wait(10)
+        return real_build(recs)
+
+    t._build_snapshot = gated_build  # instance attr shadows the static
+    results = []
+    th = threading.Thread(target=lambda: results.append(t.fold()))
+    th.start()
+    assert build_started.wait(10)
+    t._build_snapshot = real_build
+    # a rebuild with DIFFERENT contents: e0..e9 only, new keys
+    t.bulk_load(
+        [
+            Record(
+                entity_id=f"e{i}",
+                keys=np.asarray([i + 500], np.int32),
+                alt_lo=-np.inf,
+                alt_hi=np.inf,
+                t_start=0,
+                t_end=10**18,
+                owner_id=0,
+            )
+            for i in range(10)
+        ]
+    )
+    release_build.set()
+    th.join(10)
+    assert results == [False]  # the stale fold abandoned its snapshot
+    assert _q(t, [505]) == ["e5"]
+    assert _q(t, [5]) == []  # old keys gone: rebuild state won
+    assert t.stats()["tier_count"] == 1
+    t.close()
+
+
+def test_differential_tiered_vs_single_snapshot_fuzz():
+    """Random upserts/removes/folds/compactions: the tiered table and
+    a tiering-disabled (tier_ratio=0 — every fold a full rebuild, the
+    pre-tier behavior) table must answer every query identically."""
+    rng = np.random.default_rng(7)
+    tiered = _table(tier_ratio=0.3)
+    flat = _table(tier_ratio=0)
+    max_tiers = 0
+    try:
+        for step in range(400):
+            roll = rng.random()
+            if roll < 0.6:
+                i = int(rng.integers(0, 80))
+                keys = np.unique(
+                    rng.integers(0, 60, int(rng.integers(1, 5)))
+                ).astype(np.int32)
+                alt = float(rng.uniform(0, 100))
+                t0 = int(rng.integers(0, 4))
+                t1 = t0 + int(rng.integers(1, 6))
+                owner = int(rng.integers(0, 3))
+                for t in (tiered, flat):
+                    t.upsert(f"e{i}", keys, alt, alt + 50.0, t0, t1, owner)
+            elif roll < 0.75:
+                i = int(rng.integers(0, 80))
+                assert tiered.remove(f"e{i}") == flat.remove(f"e{i}")
+            elif roll < 0.92:
+                tiered.fold()
+                flat.fold()
+            else:
+                tiered.compact()
+                flat.fold()
+            max_tiers = max(max_tiers, tiered.stats()["tier_count"])
+            qk = np.unique(rng.integers(0, 60, 4)).astype(np.int32)
+            now = int(rng.integers(0, 6))
+            owner_q = (
+                None if rng.random() < 0.7 else int(rng.integers(0, 3))
+            )
+            a = tiered.query(qk, now=now, owner_id=owner_q)
+            b = flat.query(qk, now=now, owner_id=owner_q)
+            assert a == b, (step, a, b)
+        # the fuzz must actually have exercised the tier stack
+        assert max_tiers >= 2
+        assert tiered.stats()["tier_minor_folds"] > 0
+    finally:
+        tiered.close()
+        flat.close()
+
+
+def test_explicit_minor_fold_before_any_base_is_major():
+    """fold(major=False) on a table with no tier stack yet must build
+    the base instead of crashing on the missing L0."""
+    t = _table(tier_ratio=10.0)
+    _put(t, 1, [5])
+    assert t.fold(major=False)
+    assert t.stats()["tier_count"] == 1
+    assert _q(t, [5]) == ["e1"]
+    t.close()
+
+
+def test_mark_dead_helper_no_alloc_on_miss():
+    snap = tiersmod.build_snapshot([])
+    tiers = (tiersmod.make_tier(snap),)
+    assert tiersmod.mark_dead(tiers, "nope") is tiers
+
+
+def test_dead_recent_folds_into_base_past_threshold():
+    """The per-write shadow cost must stay bounded: once dead_recent
+    crosses DEAD_FOLD_THRESHOLD it folds into the stable sorted base
+    array, so neither writes nor query filtering ever pay
+    O(accumulated churn)."""
+    import dss_tpu.dar.tiers as tm
+
+    old = tm.DEAD_FOLD_THRESHOLD
+    tm.DEAD_FOLD_THRESHOLD = 8
+    try:
+        t = _table(tier_ratio=1000.0)
+        for i in range(40):
+            _put(t, i, [i])
+        t.fold()  # major: 40 in L0
+        for i in range(20):
+            _put(t, i, [i + 200])  # shadow 20 L0 slots (> threshold)
+        l0 = t._state.tiers[0]
+        assert len(l0.dead_base) > 0  # the fold-into-base fired
+        assert len(l0.dead_recent) <= 8
+        assert l0.dead_count == 20
+        for i in range(20):
+            assert _q(t, [i]) == []
+            assert _q(t, [i + 200]) == [f"e{i}"]
+        for i in range(20, 40):
+            assert _q(t, [i]) == [f"e{i}"]
+        t.close()
+    finally:
+        tm.DEAD_FOLD_THRESHOLD = old
