@@ -28,6 +28,7 @@ bench-all: bench
 	$(PY) benchmarks/bench_scd_write.py
 	$(PY) benchmarks/bench_fanout.py
 	$(PY) benchmarks/bench_sharded_replay.py
+	$(PY) benchmarks/bench_multihost.py
 
 serve:
 	$(PY) -m dss_tpu.cmds.server --addr :8082 --enable_scd \

@@ -1,0 +1,91 @@
+"""Multi-host mesh leg: the two-process CPU dryrun measured.
+
+Runs the full `dss_tpu.cmds.multihost_dryrun` acceptance (fixture ->
+single-process reference -> two-process mesh -> peer-loss leg) and
+reports the DCN seam's numbers: per-host refresh bytes/s (tier bytes
+each host materializes for its addressable shards per second of fold
+wall time) and cross-process query qps (every query's "sp" all_gather
+crosses the process boundary).  Emits one JSON line AND writes
+MULTICHIP_r06.json at the repo root with the acceptance verdict
+(`ok`, `num_processes`, bit-identical + degraded-failover checks).
+
+  python benchmarks/bench_multihost.py
+Env: DSS_BENCH_MH_PROCS (2), DSS_BENCH_MH_DEVS (2 per process),
+     DSS_BENCH_MH_REPS (10 query rounds for the qps figure)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks._common import emit  # noqa: E402
+from dss_tpu.cmds.multihost_dryrun import run_dryrun  # noqa: E402
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main():
+    procs = int(os.environ.get("DSS_BENCH_MH_PROCS", 2))
+    devs = int(os.environ.get("DSS_BENCH_MH_DEVS", 2))
+    reps = int(os.environ.get("DSS_BENCH_MH_REPS", 10))
+
+    with tempfile.TemporaryDirectory(prefix="dss-mh-bench-") as td:
+        verdict = run_dryrun(
+            td, num_processes=procs, devices_per_process=devs, reps=reps
+        )
+
+    multi = verdict.get("multi", {})
+    stats = multi.get("stats", {})
+    refresh_bytes = stats.get("dss_multihost_refresh_bytes", 0)
+    refresh_s = multi.get("refresh_s", 0) or 1e-9
+    qps = multi.get("query_qps", 0)
+
+    record = {
+        "ok": bool(verdict.get("ok")),
+        "rc": 0 if verdict.get("ok") else 1,
+        "num_processes": procs,
+        "devices_per_process": devs,
+        "mesh": multi.get("mesh"),
+        "placement": multi.get("placement"),
+        "bit_identical": verdict.get("bit_identical"),
+        "peerloss_ok": verdict.get("peerloss_ok"),
+        "degraded_flag_seen": verdict.get("peerloss", {}).get("degraded"),
+        "cross_process_query_qps": qps,
+        "refresh_bytes": refresh_bytes,
+        "refresh_s": round(refresh_s, 3),
+        "refresh_bytes_per_s": round(refresh_bytes / refresh_s, 1),
+        "commands": stats.get("dss_multihost_commands"),
+        "reference_query_qps": verdict.get("reference", {}).get(
+            "query_qps"
+        ),
+    }
+    with open(
+        os.path.join(ROOT, "MULTICHIP_r06.json"), "w", encoding="utf-8"
+    ) as fh:
+        json.dump(record, fh, indent=2)
+        fh.write("\n")
+
+    emit(
+        "multihost_cross_process_query_qps",
+        qps,
+        "queries/s",
+        None,
+        record,
+    )
+    if not verdict.get("ok"):
+        # keep the failure loud: the JSON above carries the stage
+        print(
+            json.dumps({"error": "multihost dryrun failed",
+                        "stage": verdict.get("stage")}),
+            file=sys.stderr,
+        )
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,506 @@
+"""Two-process CPU dryrun of the multi-host mesh (the DCN seam).
+
+The `dryrun_multichip` recipe proved the dp x sp sharding program on
+virtual devices inside ONE process; this proves the process-spanning
+version: two OS processes `jax.distributed`-join ONE mesh (gloo CPU
+collectives standing in for DCN), tail the same WAL, fold the tier
+protocol in lockstep, and answer the same sharded queries
+BIT-IDENTICALLY to a single-process run of the same mesh shape.  A
+peer-loss leg kills the follower mid-serve and asserts the leader
+flips to degraded local-only serving with unchanged answers.
+
+Three roles in one module:
+
+  python -m dss_tpu.cmds.multihost_dryrun --make_wal DIR
+      write the WAL fixture: wave A (wal_a) + a live tail (wal_b)
+      through the real store + services, all four entity classes.
+
+  python -m dss_tpu.cmds.multihost_dryrun --process_id I \\
+      --num_processes N --jax_coordinator 127.0.0.1:PORT \\
+      --multihost_dryrun 2 --wal ... [--out ...] [--peerloss]
+      one mesh worker (process 0 = leader, writes the result JSON).
+
+  run_dryrun(...)  — the orchestrator API: spawns the fixture writer
+      and the workers, compares against the single-process reference,
+      returns the combined verdict (used by __graft_entry__,
+      benchmarks/bench_multihost.py, tests, and CI).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import socket
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+# fixture geometry: one small box per index, disjoint across indexes
+LATS = [40.0 + 0.1 * i for i in range(6)]
+NEW_LAT = 41.0  # wave-B addition
+
+
+def _box(lat):
+    return [
+        (lat, -100.0), (lat + 0.02, -100.0),
+        (lat + 0.02, -99.98), (lat, -99.98),
+    ]
+
+
+def _iso(off):
+    return time.strftime(
+        "%Y-%m-%dT%H:%M:%SZ", time.gmtime(time.time() + off)
+    )
+
+
+def _isa_params(lat):
+    return {
+        "extents": {
+            "spatial_volume": {
+                "footprint": {
+                    "vertices": [
+                        {"lat": a, "lng": b} for a, b in _box(lat)
+                    ]
+                },
+                "altitude_lo": 10.0,
+                "altitude_hi": 300.0,
+            },
+            "time_start": _iso(60),
+            "time_end": _iso(3600),
+        },
+        "flights_url": "https://uss.example.com/f",
+    }
+
+
+def _rid_sub_params(lat):
+    return {
+        "extents": {
+            "spatial_volume": {
+                "footprint": {
+                    "vertices": [
+                        {"lat": a, "lng": b} for a, b in _box(lat)
+                    ]
+                },
+                "altitude_lo": 0.0,
+                "altitude_hi": 3000.0,
+            },
+            "time_start": _iso(60),
+            "time_end": _iso(3600),
+        },
+        "callbacks": {
+            "identification_service_area_url": "https://uss.example.com"
+        },
+    }
+
+
+def _op_params(lat):
+    return {
+        "extents": [
+            {
+                "volume": {
+                    "outline_polygon": {
+                        "vertices": [
+                            {"lat": a, "lng": b} for a, b in _box(lat)
+                        ]
+                    },
+                    "altitude_lower": {
+                        "value": 50.0, "reference": "W84", "units": "M"
+                    },
+                    "altitude_upper": {
+                        "value": 200.0, "reference": "W84", "units": "M"
+                    },
+                },
+                "time_start": {"value": _iso(60), "format": "RFC3339"},
+                "time_end": {"value": _iso(3600), "format": "RFC3339"},
+            }
+        ],
+        "uss_base_url": "https://uss.example.com",
+        "new_subscription": {"uss_base_url": "https://uss.example.com"},
+        "state": "Accepted",
+        "old_version": 0,
+        "key": [],
+    }
+
+
+def make_wal(outdir: str) -> None:
+    """Write the fixture: wal_a (wave A) + wal_b (the live tail the
+    leader appends mid-run, exercising the DELTA fold path)."""
+    from dss_tpu.dar.dss_store import DSSStore
+    from dss_tpu.services.rid import RIDService
+    from dss_tpu.services.scd import SCDService
+
+    wal = os.path.join(outdir, "full.wal")
+    store = DSSStore(storage="memory", wal_path=wal)
+    rid = RIDService(store.rid, store.clock)
+    scd = SCDService(store.scd, store.clock)
+    ids = {"isas": [], "ops": []}
+    for i, lat in enumerate(LATS):
+        owner = f"uss{i}"
+        isa_id = f"00000000-0000-4000-8000-00000000a{i:03d}"
+        rid.create_isa(isa_id, _isa_params(lat), owner)
+        ids["isas"].append(isa_id)
+        rid.create_subscription(
+            f"00000000-0000-4000-8000-00000000b{i:03d}",
+            _rid_sub_params(lat),
+            owner,
+        )
+        op_id = f"00000000-0000-4000-8000-00000000c{i:03d}"
+        scd.put_operation(op_id, _op_params(lat), owner)
+        ids["ops"].append(op_id)
+    cut = os.path.getsize(wal)
+    # wave B: an add, an update-shadowing write, and a delete — the
+    # delta fold must ship adds AND hide superseded/deleted base rows
+    rid.create_isa(
+        "00000000-0000-4000-8000-00000000a900",
+        _isa_params(NEW_LAT),
+        "uss9",
+    )
+    v = rid.get_isa(ids["isas"][0])["service_area"]["version"]
+    rid.delete_isa(ids["isas"][0], v, "uss0")
+    scd.delete_operation(ids["ops"][1], "uss1")
+    store.close()
+    with open(wal, "rb") as fh:
+        blob = fh.read()
+    with open(os.path.join(outdir, "wal_a.jsonl"), "wb") as fh:
+        fh.write(blob[:cut])
+    with open(os.path.join(outdir, "wal_b.jsonl"), "wb") as fh:
+        fh.write(blob[cut:])
+
+
+# -- worker -------------------------------------------------------------------
+
+
+def _query_keys():
+    """Deterministic per-box DAR key sets (computed identically in
+    every process — pure geometry, no RNG)."""
+    from dss_tpu.geo import covering as geo_covering
+    from dss_tpu.geo import s2cell
+
+    out = []
+    for lat in LATS + [NEW_LAT]:
+        cells = geo_covering.covering_polygon(_box(lat))
+        out.append(s2cell.cell_to_dar_key(cells))
+    return out
+
+
+def _run_queries(replica, keys_list, now):
+    import numpy as np
+
+    b = len(keys_list)
+    res = {}
+    for cls in ("ops", "isas", "rid_subs", "scd_subs"):
+        res[cls] = replica.query_batch(
+            keys_list,
+            np.full(b, -np.inf, np.float32),
+            np.full(b, np.inf, np.float32),
+            np.full(b, -(2**62), np.int64),
+            np.full(b, 2**62, np.int64),
+            now=now,
+            cls=cls,
+        )
+    return res
+
+
+def worker(args) -> None:
+    from dss_tpu.parallel import multihost as mh
+
+    cfg = mh.MultihostConfig(
+        coordinator=args.jax_coordinator,
+        process_id=args.process_id,
+        num_processes=args.num_processes,
+        dryrun_devices=args.multihost_dryrun,
+        watchdog_interval_s=args.watchdog_interval,
+        watchdog_timeout_s=args.watchdog_timeout,
+    )
+    runtime = mh.initialize(cfg)
+
+    from dss_tpu.parallel.mesh import make_global_mesh
+
+    placement = make_global_mesh(dp=1)
+    replica = mh.MultihostReplica(
+        runtime,
+        placement,
+        wal_path=args.wal,
+        warm_batches=(1,),
+    )
+
+    if not runtime.is_leader:
+        # the peer-loss leg: the leader orders this follower to die
+        # abruptly mid-serve (a SIGKILL'd host, not a clean exit)
+        replica.extra_commands["die"] = lambda head: os._exit(9)
+        try:
+            replica.run_follower()
+            rc = 0
+        except mh.MultihostDegradedError:
+            rc = 3
+        replica.close()
+        runtime.close()
+        sys.exit(rc)
+
+    # -- leader ---------------------------------------------------------------
+    now = int(time.time() * 1e9) + int(120e9)
+    keys_list = _query_keys()
+    out = {
+        "num_processes": runtime.num_processes,
+        "mesh": {"dp": placement.dp, "sp": placement.sp},
+        "placement": {
+            str(p): list(cols)
+            for p, cols in placement.sp_by_process.items()
+        },
+    }
+    t0 = time.perf_counter()
+    replica.sync()  # wave A: major fold per class
+    out["wave_a"] = _run_queries(replica, keys_list, now)
+    with open(args.wal_b, "rb") as src, open(args.wal, "ab") as dst:
+        dst.write(src.read())
+    replica.sync()  # wave B: delta fold
+    out["refresh_s"] = round(time.perf_counter() - t0, 3)
+    out["wave_b"] = _run_queries(replica, keys_list, now)
+
+    # steady-state cross-process query throughput (every round runs
+    # 4 classes x len(keys_list) queries through the mesh)
+    reps = max(args.reps, 1)
+    nq = 0
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        r = _run_queries(replica, keys_list, now)
+        nq += sum(len(v) for v in r.values())
+    out["query_s"] = round(time.perf_counter() - t0, 3)
+    out["queries"] = nq
+    out["query_qps"] = round(nq / max(out["query_s"], 1e-9), 1)
+
+    if args.peerloss and runtime.num_processes > 1:
+        replica.broadcast_control("die")
+        deadline = time.monotonic() + 3 * args.watchdog_timeout + 5
+        while not runtime.degraded and time.monotonic() < deadline:
+            time.sleep(0.05)
+        out["degraded"] = runtime.degraded
+        # host-only window: the mesh is gone, answers must still be
+        # exact (served straight from the tailed record map)
+        host_res = _run_queries(replica, keys_list, now)
+        out["host_only_match"] = host_res == out["wave_b"]
+        replica.sync()  # re-home on the local-devices mesh
+        local_res = _run_queries(replica, keys_list, now)
+        out["local_mesh_match"] = local_res == out["wave_b"]
+
+    out["stats"] = {
+        k: v
+        for k, v in replica.stats().items()
+        if isinstance(v, (int, float))
+    }
+    replica.close()
+    runtime.close()
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as fh:
+            json.dump(out, fh)
+    else:
+        print(json.dumps(out))
+
+
+# -- orchestrator -------------------------------------------------------------
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _spawn(argv, **kw):
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # workers pin cpu themselves
+    return subprocess.Popen(
+        [sys.executable, "-m", "dss_tpu.cmds.multihost_dryrun", *argv],
+        cwd=REPO,
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        **kw,
+    )
+
+
+def _run_leg(
+    workdir: str,
+    fixture: str,
+    num_processes: int,
+    *,
+    devices_per_process: int = 2,
+    peerloss: bool = False,
+    reps: int = 3,
+    watchdog_interval: float = 0.25,
+    watchdog_timeout: float = 2.0,
+    timeout_s: float = 600.0,
+) -> dict:
+    os.makedirs(workdir, exist_ok=True)
+    wal = os.path.join(workdir, "dss.wal")
+    shutil.copyfile(os.path.join(fixture, "wal_a.jsonl"), wal)
+    out_path = os.path.join(workdir, "leader.json")
+    port = _free_port()
+    common = [
+        "--jax_coordinator", f"127.0.0.1:{port}",
+        "--num_processes", str(num_processes),
+        "--multihost_dryrun", str(devices_per_process),
+        "--wal", wal,
+        "--wal_b", os.path.join(fixture, "wal_b.jsonl"),
+        "--reps", str(reps),
+        "--watchdog_interval", str(watchdog_interval),
+        "--watchdog_timeout", str(watchdog_timeout),
+    ]
+    if peerloss:
+        common.append("--peerloss")
+    procs = []
+    for i in range(num_processes):
+        argv = ["--process_id", str(i), *common]
+        if i == 0:
+            argv += ["--out", out_path]
+        procs.append(_spawn(argv))
+    logs, rcs = [], []
+    deadline = time.monotonic() + timeout_s
+    for p in procs:
+        try:
+            log_out, _ = p.communicate(
+                timeout=max(deadline - time.monotonic(), 1.0)
+            )
+        except subprocess.TimeoutExpired:
+            p.kill()
+            log_out, _ = p.communicate()
+        logs.append(log_out)
+        rcs.append(p.returncode)
+    leader_ok = rcs[0] == 0 and os.path.exists(out_path)
+    # follower exit: 0 on clean stop; 9 when the peerloss leg killed it
+    follower_ok = all(
+        rc == (9 if peerloss else 0) for rc in rcs[1:]
+    )
+    result = {
+        "rcs": rcs,
+        "ok": leader_ok and follower_ok,
+        "log_tail": "" if leader_ok else "\n".join(
+            log[-2000:] for log in logs
+        ),
+    }
+    if leader_ok:
+        with open(out_path, "r", encoding="utf-8") as fh:
+            result["leader"] = json.load(fh)
+    return result
+
+
+def run_dryrun(
+    workdir: str,
+    *,
+    num_processes: int = 2,
+    devices_per_process: int = 2,
+    reps: int = 3,
+    timeout_s: float = 600.0,
+) -> dict:
+    """The full acceptance: fixture -> single-process reference ->
+    N-process mesh (bit-identical check) -> peer-loss leg (degraded
+    local-only check).  Returns the combined verdict dict."""
+    os.makedirs(workdir, exist_ok=True)
+    fixture = os.path.join(workdir, "fixture")
+    os.makedirs(fixture, exist_ok=True)
+    fx = _spawn(["--make_wal", fixture])
+    fx_log, _ = fx.communicate(timeout=timeout_s)
+    if fx.returncode != 0:
+        return {"ok": False, "stage": "fixture", "log": fx_log[-2000:]}
+
+    total_devices = num_processes * devices_per_process
+    ref = _run_leg(
+        os.path.join(workdir, "ref"),
+        fixture,
+        1,
+        devices_per_process=total_devices,  # same mesh shape, 1 process
+        reps=reps,
+        timeout_s=timeout_s,
+    )
+    if not ref["ok"]:
+        return {"ok": False, "stage": "reference", **ref}
+    multi = _run_leg(
+        os.path.join(workdir, "multi"),
+        fixture,
+        num_processes,
+        devices_per_process=devices_per_process,
+        reps=reps,
+        timeout_s=timeout_s,
+    )
+    if not multi["ok"]:
+        return {"ok": False, "stage": "multi", **multi}
+    bit_identical = (
+        multi["leader"]["wave_a"] == ref["leader"]["wave_a"]
+        and multi["leader"]["wave_b"] == ref["leader"]["wave_b"]
+    )
+    peer = _run_leg(
+        os.path.join(workdir, "peerloss"),
+        fixture,
+        num_processes,
+        devices_per_process=devices_per_process,
+        peerloss=True,
+        reps=1,
+        timeout_s=timeout_s,
+    )
+    pl = peer.get("leader", {})
+    peerloss_ok = bool(
+        peer["ok"]
+        and pl.get("degraded")
+        and pl.get("host_only_match")
+        and pl.get("local_mesh_match")
+    )
+    return {
+        "ok": bool(bit_identical and peerloss_ok),
+        "num_processes": num_processes,
+        "devices_per_process": devices_per_process,
+        "bit_identical": bit_identical,
+        "peerloss_ok": peerloss_ok,
+        "reference": ref["leader"],
+        "multi": multi["leader"],
+        "peerloss": pl or {k: v for k, v in peer.items() if k != "leader"},
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--make_wal", default="", help="write the WAL fixture")
+    ap.add_argument("--jax_coordinator", default="")
+    ap.add_argument("--process_id", type=int, default=0)
+    ap.add_argument("--num_processes", type=int, default=1)
+    ap.add_argument("--multihost_dryrun", type=int, default=2)
+    ap.add_argument("--wal", default="")
+    ap.add_argument("--wal_b", default="")
+    ap.add_argument("--out", default="")
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--peerloss", action="store_true")
+    ap.add_argument("--watchdog_interval", type=float, default=0.25)
+    ap.add_argument("--watchdog_timeout", type=float, default=2.0)
+    ap.add_argument(
+        "--run", action="store_true",
+        help="orchestrate the full dryrun into ./MULTIHOST_DRYRUN.json",
+    )
+    args = ap.parse_args()
+    if args.make_wal:
+        # fixture writing is host-side only; pin the cheap backend
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        make_wal(args.make_wal)
+        return
+    if args.run or not args.jax_coordinator:
+        import tempfile
+
+        with tempfile.TemporaryDirectory(prefix="dss-mh-") as td:
+            verdict = run_dryrun(td)
+        print(json.dumps(verdict, indent=2)[:4000])
+        sys.exit(0 if verdict.get("ok") else 1)
+    worker(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -128,6 +128,39 @@ def make_parser() -> argparse.ArgumentParser:
         "multi-chip path without chips; the driver's dryrun analog)",
     )
     p.add_argument(
+        "--jax_coordinator",
+        default="",
+        help="host:port of process 0's jax.distributed coordination "
+        "service: joins this server to a PROCESS-SPANNING mesh (the "
+        "multi-host DCN seam, parallel/multihost.py).  Env fallback "
+        "DSS_JAX_COORDINATOR.  Requires --process_id + "
+        "--num_processes on every process",
+    )
+    p.add_argument(
+        "--process_id",
+        type=int,
+        default=None,
+        help="this process's index in the multi-host mesh (0 = "
+        "leader: serves mesh queries and paces refreshes; >0 = "
+        "follower compute peer).  Env fallback DSS_PROCESS_ID",
+    )
+    p.add_argument(
+        "--num_processes",
+        type=int,
+        default=None,
+        help="total processes in the multi-host mesh.  Env fallback "
+        "DSS_NUM_PROCESSES",
+    )
+    p.add_argument(
+        "--multihost_dryrun",
+        type=int,
+        default=0,
+        help="CPU device override for the multi-host path: each "
+        "process gets N virtual CPU devices and cross-process "
+        "collectives run over gloo TCP (the DCN program without "
+        "TPUs).  Env fallback DSS_MULTIHOST_DRYRUN",
+    )
+    p.add_argument(
         "--sharded_replica",
         default="",
         help="'dp,sp' mesh shape: serve multi-chip ShardedDar read "
@@ -401,6 +434,7 @@ def build(args) -> web.Application:
     )
     metrics.set_info("dss_build_info", build_info())
 
+    mh_runtime = getattr(args, "_mh_runtime", None)
     replica = None
     if args.sharded_replica:
         import jax
@@ -416,52 +450,91 @@ def build(args) -> web.Application:
                 f"--sharded_replica must be 'dp,sp' (got "
                 f"{args.sharded_replica!r})"
             )
-        devs = jax.devices()
-        if len(devs) < dp * sp:
-            raise SystemExit(
-                f"--sharded_replica {dp},{sp} needs {dp * sp} devices, "
-                f"have {len(devs)}"
-            )
-        mesh = Mesh(
-            _np.array(devs[: dp * sp]).reshape(dp, sp), ("dp", "sp")
-        )
+        region_client = None
         if args.region_url:
             from dss_tpu.region.client import RegionClient
 
-            replica = ShardedReplica(
-                mesh,
-                region_client=RegionClient(
-                    args.region_url,
-                    (args.instance_id or "dss") + "-replica",
-                    auth_token=region_token or None,
-                ),
-                # every bucket a mesh-offloaded chunk can land in
-                # (chunks are <= 64; remainders bucket to 16/32): the
-                # first offload must never stall on a compile
-                warm_batches=(1, 32, 64),
+            region_client = RegionClient(
+                args.region_url,
+                (args.instance_id or "dss") + "-replica",
+                auth_token=region_token or None,
             )
-        elif args.wal_path:
-            replica = ShardedReplica(
-                mesh, wal_path=args.wal_path, warm_batches=(1, 32, 64)
-            )
-        else:
+        elif not args.wal_path:
             raise SystemExit(
                 "--sharded_replica needs --wal_path or --region_url "
                 "(a log to tail)"
             )
-        replica.start(args.replica_refresh_interval)
-        # oversized bounded-staleness search batches ride the mesh
-        store.attach_mesh_replica(replica)
-        log.info(
-            "sharded replica serving all entity classes on a %dx%d "
-            "mesh (%s)",
-            dp, sp, "region log" if args.region_url else "wal",
-        )
+        # every bucket a mesh-offloaded chunk can land in (chunks are
+        # <= 64; remainders bucket to 16/32): the first offload must
+        # never stall on a compile
+        warm = (1, 32, 64)
+        if mh_runtime is not None:
+            # process-spanning mesh: dp,sp names the GLOBAL shape
+            from dss_tpu.parallel.mesh import make_global_mesh
+            from dss_tpu.parallel.multihost import MultihostReplica
+
+            try:
+                placement = make_global_mesh(dp=dp, sp=sp)
+            except ValueError as e:
+                raise SystemExit(f"--sharded_replica {dp},{sp}: {e}")
+            replica = MultihostReplica(
+                mh_runtime,
+                placement,
+                wal_path=args.wal_path or None,
+                region_client=region_client,
+                warm_batches=warm,
+            )
+            if mh_runtime.is_leader:
+                replica.start(args.replica_refresh_interval)
+                store.attach_mesh_replica(replica)
+            else:
+                # compute peer: replay the leader's command stream;
+                # its own HTTP reads answer exactly from the host map
+                threading.Thread(
+                    target=replica.run_follower,
+                    name="multihost-follower",
+                    daemon=True,
+                ).start()
+            log.info(
+                "multi-host sharded replica: process %d/%d, global "
+                "%dx%d mesh, placement %s (%s)",
+                mh_runtime.process_id, mh_runtime.num_processes,
+                dp, sp, placement.describe(),
+                "region log" if args.region_url else "wal",
+            )
+        else:
+            devs = jax.devices()
+            if len(devs) < dp * sp:
+                raise SystemExit(
+                    f"--sharded_replica {dp},{sp} needs {dp * sp} "
+                    f"devices, have {len(devs)}"
+                )
+            mesh = Mesh(
+                _np.array(devs[: dp * sp]).reshape(dp, sp), ("dp", "sp")
+            )
+            if region_client is not None:
+                replica = ShardedReplica(
+                    mesh, region_client=region_client, warm_batches=warm
+                )
+            else:
+                replica = ShardedReplica(
+                    mesh, wal_path=args.wal_path, warm_batches=warm
+                )
+            replica.start(args.replica_refresh_interval)
+            # oversized bounded-staleness search batches ride the mesh
+            store.attach_mesh_replica(replica)
+            log.info(
+                "sharded replica serving all entity classes on a "
+                "%dx%d mesh (%s)",
+                dp, sp, "region log" if args.region_url else "wal",
+            )
 
     def stats_fn():
         out = store.stats()
         if replica is not None:
             out.update(replica.stats())
+        elif mh_runtime is not None:
+            out.update(mh_runtime.stats())
         return out
 
     app = build_app(
@@ -589,6 +662,30 @@ def main():
     from dss_tpu.cmds import make_ssl_context
 
     ssl_ctx = make_ssl_context(args.tls_cert, args.tls_key)
+
+    # multi-host mesh: join BEFORE any jax backend touch (flags with
+    # DSS_JAX_COORDINATOR / DSS_PROCESS_ID / DSS_NUM_PROCESSES /
+    # DSS_MULTIHOST_DRYRUN env fallbacks)
+    from dss_tpu.parallel.multihost import MultihostConfig
+    from dss_tpu.parallel import multihost as _mh
+
+    mh_cfg = MultihostConfig.from_flags(
+        args.jax_coordinator,
+        args.process_id,
+        args.num_processes,
+        args.multihost_dryrun,
+    )
+    if mh_cfg is not None:
+        if args.workers > 0:
+            raise SystemExit(
+                "--workers and --jax_coordinator are mutually "
+                "exclusive (one process per host in a multi-host mesh)"
+            )
+        if args.worker_reader:
+            raise SystemExit(
+                "--worker_reader cannot join a multi-host mesh"
+            )
+        args._mh_runtime = _mh.initialize(mh_cfg)
 
     if args.worker_reader:
         _watch_parent()

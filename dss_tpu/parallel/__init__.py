@@ -14,7 +14,12 @@ The EntityTable (attribute columns) is replicated — it is small
 relative to postings and every shard needs random access to it.
 """
 
-from dss_tpu.parallel.mesh import make_mesh
+from dss_tpu.parallel.mesh import (
+    MeshPlacement,
+    make_global_mesh,
+    make_mesh,
+    mesh_spans_processes,
+)
 from dss_tpu.parallel.sharded import (
     ShardedDar,
     shard_postings,
@@ -22,7 +27,10 @@ from dss_tpu.parallel.sharded import (
 )
 
 __all__ = [
+    "MeshPlacement",
+    "make_global_mesh",
     "make_mesh",
+    "mesh_spans_processes",
     "ShardedDar",
     "shard_postings",
     "sharded_conflict_query_batch",

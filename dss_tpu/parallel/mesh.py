@@ -8,7 +8,7 @@ query streams run in parallel.
 
 from __future__ import annotations
 
-from typing import Optional, Sequence, Tuple
+from typing import Dict, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -46,3 +46,100 @@ def make_mesh(
         raise ValueError(f"dp*sp = {dp}*{sp} != n_devices = {n_devices}")
     arr = np.asarray(devices).reshape(dp, sp)
     return Mesh(arr, ("dp", "sp"))
+
+
+def mesh_spans_processes(mesh: Mesh) -> bool:
+    """True when the mesh includes devices of more than one OS process
+    (a multi-host mesh: the "sp" all_gather crosses DCN, and host
+    arrays can only be materialized shard-by-addressable-shard)."""
+    procs = {d.process_index for d in mesh.devices.flat}
+    return len(procs) > 1
+
+
+class MeshPlacement(NamedTuple):
+    """A ("dp", "sp") mesh over GLOBAL devices plus the explicit
+    host<->shard placement bookkeeping multi-host serving needs:
+    which process owns which mesh coordinates, and which "sp" postings
+    ranges this process can address (and therefore must fold/hold)."""
+
+    mesh: Mesh
+    dp: int
+    sp: int
+    # [dp, sp] process index owning each mesh coordinate
+    owner: np.ndarray
+    # process index -> sorted tuple of sp columns it owns >=1 coord of
+    sp_by_process: Dict[int, Tuple[int, ...]]
+    process_index: int
+    num_processes: int
+
+    @property
+    def addressable_sp(self) -> Tuple[int, ...]:
+        """The postings-shard columns THIS process folds and holds."""
+        return self.sp_by_process.get(self.process_index, ())
+
+    def describe(self) -> str:
+        return " ".join(
+            f"p{p}:sp{list(cols)}"
+            for p, cols in sorted(self.sp_by_process.items())
+        )
+
+
+def make_global_mesh(
+    *,
+    dp: Optional[int] = None,
+    sp: Optional[int] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> MeshPlacement:
+    """Build the ("dp", "sp") mesh over the GLOBAL device list (every
+    process's devices, jax.distributed-joined) with explicit placement.
+
+    Devices are ordered (process_index, id) and reshaped row-major, so
+    each process's devices land on CONTIGUOUS "sp" columns whenever
+    local device counts divide sp: a host then owns contiguous
+    postings ranges, per-host folds touch one contiguous block, and
+    the "sp" all_gather's inter-host hops are the DCN seam.
+
+    Defaults to dp=1 for a process-spanning mesh: the query batch is
+    replicated to every process anyway (SPMD), so the scaling
+    dimension across hosts is the postings axis.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = sorted(devices, key=lambda d: (d.process_index, d.id))
+    n = len(devices)
+    if dp is None and sp is None:
+        dp, sp = (1, n) if _spans(devices) else _factor(n)
+    elif dp is None:
+        dp = n // sp
+    elif sp is None:
+        sp = n // dp
+    if dp * sp != n:
+        raise ValueError(f"dp*sp = {dp}*{sp} != n_devices = {n}")
+    arr = np.asarray(devices, dtype=object).reshape(dp, sp)
+    mesh = Mesh(arr, ("dp", "sp"))
+    owner = np.asarray(
+        [[d.process_index for d in row] for row in arr], dtype=np.int64
+    )
+    sp_by_process: Dict[int, Tuple[int, ...]] = {}
+    for p in sorted({int(x) for x in owner.flat}):
+        cols = sorted(
+            {j for j in range(sp) if (owner[:, j] == p).any()}
+        )
+        sp_by_process[p] = tuple(cols)
+    try:
+        proc_idx = jax.process_index()
+    except Exception:  # pragma: no cover — pre-distributed-init
+        proc_idx = 0
+    return MeshPlacement(
+        mesh=mesh,
+        dp=dp,
+        sp=sp,
+        owner=owner,
+        sp_by_process=sp_by_process,
+        process_index=proc_idx,
+        num_processes=len(sp_by_process),
+    )
+
+
+def _spans(devices) -> bool:
+    return len({d.process_index for d in devices}) > 1

@@ -43,6 +43,7 @@ from typing import Dict, List, NamedTuple, Optional, Tuple
 import numpy as np
 
 from dss_tpu.dar import codec
+from dss_tpu.dar import oracle
 from dss_tpu.dar import tiers as tiersmod
 from dss_tpu.dar.oracle import Record
 from dss_tpu.geo import s2cell
@@ -81,6 +82,13 @@ class _WalTail:
         self._offset = 0
         self._checked_head = False
 
+    @property
+    def position(self) -> int:
+        """Consumed byte offset — the multihost refresh-cut currency
+        (every process tails the same log; identical offsets mean
+        identical record prefixes)."""
+        return self._offset
+
     def at_end(self) -> bool:
         """True when everything durably appended has been consumed —
         the read-your-writes gate for mesh offload (a committed write
@@ -90,7 +98,9 @@ class _WalTail:
         except OSError:
             return not os.path.exists(self.path)
 
-    def poll(self) -> List[dict]:
+    def poll(self, limit: Optional[int] = None) -> List[dict]:
+        """`limit` stops consumption at that byte offset (a follower
+        tailing to the leader's broadcast cut, never past it)."""
         if not os.path.exists(self.path):
             return []
         out = []
@@ -98,6 +108,8 @@ class _WalTail:
             fh.seek(self._offset)
             while True:
                 pos = fh.tell()
+                if limit is not None and pos >= limit:
+                    break
                 line = fh.readline()
                 if not line:
                     break
@@ -140,6 +152,12 @@ class _RegionTail:
         self.errors = 0  # consecutive fetch failures (operability)
         self.caught_up = False  # reached head at the last poll
 
+    @property
+    def position(self) -> int:
+        """Next log entry index to apply — the multihost refresh-cut
+        currency in region mode."""
+        return self._applied
+
     def at_end(self) -> bool:
         """Best-effort: head reached at the LAST poll.  Region-mode
         reads are bounded-stale by design (non-writing instances serve
@@ -147,7 +165,7 @@ class _RegionTail:
         than strict read-your-writes."""
         return self.caught_up
 
-    def poll(self) -> List[dict]:
+    def poll(self, limit: Optional[int] = None) -> List[dict]:
         from dss_tpu.region.client import (
             EpochChanged,
             RegionError,
@@ -200,9 +218,13 @@ class _RegionTail:
                         self._applied = 0
                     continue
                 for idx, recs in entries:
-                    if idx >= self._applied:
+                    if idx >= self._applied and (
+                        limit is None or idx < limit
+                    ):
                         out.extend(recs)
                         self._applied = idx + 1
+                if limit is not None and self._applied >= limit:
+                    return out
                 if self._applied >= head:
                     self.caught_up = True
                     return out
@@ -276,6 +298,10 @@ class ShardedReplica:
         }
         self._applied_records = 0
         self._apply_errors = 0
+        # host->device bytes materialized by snapshot builds (per-host
+        # refresh traffic: on a multi-host mesh this is what each
+        # process ships to its addressable shards per refresh)
+        self.device_bytes_built = 0
         self._rebuilds = 0
         self._delta_refreshes = 0
         self._major_rebuilds = 0
@@ -401,13 +427,39 @@ class ShardedReplica:
         # which the spatial replica does not serve
         self._applied_records += 1
 
-    def poll_once(self) -> int:
+    def tail_position(self) -> int:
+        """The tail's consumed position (WAL byte offset / region
+        entry index) — the multihost refresh-cut currency."""
+        return self._tail.position
+
+    def state_fingerprint(self) -> dict:
+        """Cheap per-class divergence detector for lockstep folds:
+        processes that consumed the same log prefix MUST agree on
+        these counts before issuing the fold's collectives (a
+        divergent fold would build different array shapes and wedge or
+        corrupt the mesh)."""
+        with self._mu:
+            return {
+                "applied": self._applied_records,
+                "apply_errors": self._apply_errors,
+                "classes": {
+                    c: [
+                        len(self._records[c]),
+                        len(self._delta[c]),
+                        len(self._shadow[c]),
+                        len(self._base[c]),
+                    ]
+                    for c in CLASSES
+                },
+            }
+
+    def poll_once(self, limit: Optional[int] = None) -> int:
         """Ingest any new log records; -> number applied.  One record
         that fails to apply (version skew, corrupt doc) is skipped and
         counted — it must not drop the rest of its batch (the tail
         cursor has already advanced past it)."""
         with self._mu:
-            recs = self._tail.poll()
+            recs = self._tail.poll(limit=limit)
             for rec in recs:
                 try:
                     self._apply_locked(rec)
@@ -509,6 +561,8 @@ class ShardedReplica:
         with self._mu:
             self._snapshots[cls] = snap
             self._rebuilds += 1
+            if built is not None:
+                self.device_bytes_built += built.nbytes
             if major:
                 self._major_rebuilds += 1
             else:
@@ -597,17 +651,57 @@ class ShardedReplica:
             now=now,
             cls=cls,
         )
-        ids = rows[0]
-        if owner is not None:
-            oid = self._owners.get(owner)
-            recs = self._records[cls]
-            ids = [
-                i for i in ids
-                if oid is not None
-                and i in recs
-                and recs[i].owner_id == oid
-            ]
-        return ids
+        return self.filter_owner(rows[0], cls, owner)
+
+    def filter_owner(
+        self, ids: List[str], cls: str, owner: Optional[str]
+    ) -> List[str]:
+        """Post-filter ids to one owner's entities (the subscription
+        surfaces, whose ids are owner-private)."""
+        if owner is None:
+            return ids
+        oid = self._owners.get(owner)
+        recs = self._records[cls]
+        return [
+            i for i in ids
+            if oid is not None and i in recs and recs[i].owner_id == oid
+        ]
+
+    def pad_query_batch(
+        self,
+        keys_list,  # sequence of int32 DAR-key arrays
+        alt_lo,
+        alt_hi,
+        t_start,
+        t_end,
+        *,
+        now,  # scalar or i64[B]
+    ):
+        """Normalize a batch to the padded arrays the mesh consumes —
+        split out so a multihost leader can broadcast EXACTLY what it
+        executes (identical shapes => identical collectives on every
+        process)."""
+        from dss_tpu.dar.pack import pow2_at_least
+
+        b = len(keys_list)
+        width = pow2_at_least(
+            max((len(k) for k in keys_list), default=1), lo=16
+        )
+        qkeys = np.full((b, width), -1, np.int32)
+        for i, k in enumerate(keys_list):
+            u = np.unique(np.asarray(k, np.int32))
+            qkeys[i, : len(u)] = u
+        now_arr = np.broadcast_to(
+            np.asarray(now, np.int64), (b,)
+        ).copy()
+        return (
+            qkeys,
+            np.asarray(alt_lo, np.float32),
+            np.asarray(alt_hi, np.float32),
+            np.asarray(t_start, np.int64),
+            np.asarray(t_end, np.int64),
+            now_arr,
+        )
 
     def query_batch(
         self,
@@ -624,19 +718,27 @@ class ShardedReplica:
         across the base and delta tiers; base ids in the shadow set
         (superseded/deleted since the base was built) are dropped, so
         the newest tier wins."""
+        qkeys, alo, ahi, ts, te, now_arr = self.pad_query_batch(
+            keys_list, alt_lo, alt_hi, t_start, t_end, now=now
+        )
+        return self.query_padded(cls, qkeys, alo, ahi, ts, te, now_arr)
+
+    def query_padded(
+        self,
+        cls: str,
+        qkeys: np.ndarray,  # [B, width] int32, pad -1
+        alt_lo: np.ndarray,
+        alt_hi: np.ndarray,
+        t_start: np.ndarray,
+        t_end: np.ndarray,
+        now_arr: np.ndarray,
+    ) -> List[List[str]]:
+        """The per-tier mesh query over pre-padded arrays (the shape
+        every lockstep process replays verbatim)."""
         snap = self._snapshots[cls]
-        b = len(keys_list)
+        b = qkeys.shape[0]
         if snap is None or (snap.base is None and snap.delta is None):
             return [[] for _ in range(b)]
-        from dss_tpu.dar.pack import pow2_at_least
-
-        width = pow2_at_least(
-            max((len(k) for k in keys_list), default=1), lo=16
-        )
-        qkeys = np.full((b, width), -1, np.int32)
-        for i, k in enumerate(keys_list):
-            u = np.unique(np.asarray(k, np.int32))
-            qkeys[i, : len(u)] = u
         out = [set() for _ in range(b)]
         for dar, ids, drop in (
             (snap.base, snap.base_ids, snap.shadow),
@@ -646,11 +748,11 @@ class ShardedReplica:
                 continue
             rows = dar.query_batch(
                 qkeys,
-                np.asarray(alt_lo, np.float32),
-                np.asarray(alt_hi, np.float32),
-                np.asarray(t_start, np.int64),
-                np.asarray(t_end, np.int64),
-                now=now,
+                alt_lo,
+                alt_hi,
+                t_start,
+                t_end,
+                now=now_arr,
             )
             for i, row in enumerate(rows):
                 for s in row:
@@ -659,6 +761,45 @@ class ShardedReplica:
                         if drop is None or eid not in drop:
                             out[i].add(eid)
         return [sorted(s) for s in out]
+
+    def query_batch_host(
+        self,
+        keys_list,
+        alt_lo,
+        alt_hi,
+        t_start,
+        t_end,
+        *,
+        now,
+        cls: str = "ops",
+    ) -> List[List[str]]:
+        """Exact host-side answer straight from the record map — the
+        degraded-mode path when no mesh (global or local) is usable.
+        Same record state the mesh folds from, so results match."""
+        b = len(keys_list)
+        now_arr = np.broadcast_to(np.asarray(now, np.int64), (b,))
+        with self._mu:
+            recs = dict(self._records[cls])
+        out = []
+        for i in range(b):
+            alo = float(np.asarray(alt_lo).ravel()[i])
+            ahi = float(np.asarray(alt_hi).ravel()[i])
+            ts = int(np.asarray(t_start).ravel()[i])
+            te = int(np.asarray(t_end).ravel()[i])
+            out.append(
+                sorted(
+                    oracle.search(
+                        recs,
+                        np.asarray(keys_list[i], np.int32),
+                        None if alo == -np.inf else alo,
+                        None if ahi == np.inf else ahi,
+                        None if ts == NO_TIME_LO else ts,
+                        None if te == NO_TIME_HI else te,
+                        int(now_arr[i]),
+                    )
+                )
+            )
+        return out
 
     def stats(self) -> dict:
         out = {

@@ -49,6 +49,7 @@ _SHMAP_NOCHECK = {
 from dss_tpu.dar import oracle
 from dss_tpu.dar.oracle import Record
 from dss_tpu.dar.pack import pack_records
+from dss_tpu.parallel.mesh import mesh_spans_processes
 from dss_tpu.ops.conflict import (
     INT32_MAX,
     NO_TIME_HI,
@@ -90,6 +91,25 @@ def shard_postings(
     return keys, ents
 
 
+def put_global(mesh: Mesh, spec: P, arr: np.ndarray):
+    """Materialize a host array onto the mesh under `spec`.
+
+    Single-process meshes keep the plain device_put fast path.  A
+    process-spanning mesh cannot device_put host data onto devices it
+    does not address; make_array_from_callback instead asks each
+    process for ONLY its addressable shards — every host materializes
+    (and for sharded specs, folds device-side state for) just the
+    shard rows it owns, which is the multi-host memory story.
+    """
+    sharding = NamedSharding(mesh, spec)
+    if not mesh_spans_processes(mesh):
+        return jax.device_put(arr, sharding)
+    arr = np.asarray(arr)
+    return jax.make_array_from_callback(
+        arr.shape, sharding, lambda idx: arr[idx]
+    )
+
+
 def _local_query(
     post: Postings,
     ents: EntityTable,
@@ -124,6 +144,7 @@ def _local_query(
         "shard_results",
         "max_results",
         "with_owner",
+        "replicate_out",
     ),
 )
 def sharded_conflict_query_batch(
@@ -139,9 +160,17 @@ def sharded_conflict_query_batch(
     shard_results: int,
     max_results: int,
     with_owner: bool = False,
+    replicate_out: bool = False,
 ):
     """Batched sharded query.  Returns (slots [Q, max_results] padded
-    with INT32_MAX, overflowed [Q] bool)."""
+    with INT32_MAX, overflowed [Q] bool).
+
+    replicate_out=True all_gathers the merged results over "dp" as
+    well, so EVERY device (and therefore every process of a multi-host
+    mesh) ends up holding the full [Q, max_results] answer — required
+    when the caller cannot address all of the mesh's devices.  The
+    merged values are bit-identical to the sharded-output path: the
+    extra gather only changes placement, never the merge."""
     owner_arr = owner if with_owner else jnp.zeros(q.keys.shape[0], jnp.int32)
 
     def step(pk, pe, ents, keys, alo, ahi, ts, te, now, ow):
@@ -168,9 +197,19 @@ def sharded_conflict_query_batch(
         ovf = (
             jax.lax.psum(shard_ovf.astype(jnp.int32), "sp") > 0
         ) | (n_unique > max_results)
+        if replicate_out:
+            # [dp, Qloc, mr] -> [Q, mr] (dp-major, matching the P("dp")
+            # input split) on every device
+            out = jax.lax.all_gather(out, "dp").reshape(
+                -1, out.shape[-1]
+            )
+            ovf = jax.lax.all_gather(ovf, "dp").reshape(-1)
         return out, ovf
 
     qspec = P("dp")
+    out_specs = (
+        (P(), P()) if replicate_out else (P("dp", None), P("dp"))
+    )
     return shard_map(
         step,
         mesh=mesh,
@@ -186,7 +225,7 @@ def sharded_conflict_query_batch(
             qspec,  # now (per-query)
             qspec,  # owner
         ),
-        out_specs=(P("dp", None), P("dp")),
+        out_specs=out_specs,
         **_SHMAP_NOCHECK,
     )(
         post_key,
@@ -223,6 +262,9 @@ class ShardedDar:
         self.mesh = mesh
         self.n_sp = mesh.shape["sp"]
         self.dp = mesh.shape["dp"]
+        # process-spanning mesh: arrays materialize addressable-shard-
+        # by-shard and query outputs must replicate to every process
+        self.multihost = mesh_spans_processes(mesh)
         self.max_results = max_results
         self.shard_results = shard_results or max_results
         self.records = {slot: r for slot, r in enumerate(records)}
@@ -234,17 +276,29 @@ class ShardedDar:
             packed.post_key, packed.post_ent, self.n_sp, packed.capacity
         )
 
-        repl = NamedSharding(mesh, P())
-        sp_sh = NamedSharding(mesh, P("sp", None))
-        self.post_key = jax.device_put(skey, sp_sh)
-        self.post_ent = jax.device_put(sent, sp_sh)
+        # host->device bytes this snapshot materializes (refresh
+        # traffic accounting; on a multi-host mesh each process ships
+        # only its addressable slice of the sharded arrays)
+        self.nbytes = int(
+            skey.nbytes
+            + sent.nbytes
+            + sum(
+                np.asarray(a).nbytes
+                for a in (
+                    packed.alt_lo, packed.alt_hi, packed.t_start,
+                    packed.t_end, packed.active, packed.owner,
+                )
+            )
+        )
+        self.post_key = put_global(mesh, P("sp", None), skey)
+        self.post_ent = put_global(mesh, P("sp", None), sent)
         self.ents = EntityTable(
-            alt_lo=jax.device_put(packed.alt_lo, repl),
-            alt_hi=jax.device_put(packed.alt_hi, repl),
-            t_start=jax.device_put(packed.t_start, repl),
-            t_end=jax.device_put(packed.t_end, repl),
-            active=jax.device_put(packed.active, repl),
-            owner=jax.device_put(packed.owner, repl),
+            alt_lo=put_global(mesh, P(), packed.alt_lo),
+            alt_hi=put_global(mesh, P(), packed.alt_hi),
+            t_start=put_global(mesh, P(), packed.t_start),
+            t_end=put_global(mesh, P(), packed.t_end),
+            active=put_global(mesh, P(), packed.active),
+            owner=put_global(mesh, P(), packed.owner),
         )
 
     def query_batch(
@@ -299,23 +353,39 @@ class ShardedDar:
             now_arr = np.concatenate(
                 [now_arr, np.zeros(pad, np.int64)]
             )
-        spec = QuerySpec(
-            keys=jnp.asarray(keys_batch, jnp.int32),
-            alt_lo=jnp.asarray(alt_lo, jnp.float32),
-            alt_hi=jnp.asarray(alt_hi, jnp.float32),
-            t_start=jnp.asarray(t_start, jnp.int64),
-            t_end=jnp.asarray(t_end, jnp.int64),
-        )
+        if self.multihost:
+            # every process runs this same call in lockstep (SPMD);
+            # inputs shard onto the global mesh addressable-first and
+            # the replicated output lands whole on every process
+            mk = partial(put_global, self.mesh)
+            spec = QuerySpec(
+                keys=mk(P("dp", None), np.asarray(keys_batch, np.int32)),
+                alt_lo=mk(P("dp"), np.asarray(alt_lo, np.float32)),
+                alt_hi=mk(P("dp"), np.asarray(alt_hi, np.float32)),
+                t_start=mk(P("dp"), np.asarray(t_start, np.int64)),
+                t_end=mk(P("dp"), np.asarray(t_end, np.int64)),
+            )
+            now_dev = mk(P("dp"), np.asarray(now_arr, np.int64))
+        else:
+            spec = QuerySpec(
+                keys=jnp.asarray(keys_batch, jnp.int32),
+                alt_lo=jnp.asarray(alt_lo, jnp.float32),
+                alt_hi=jnp.asarray(alt_hi, jnp.float32),
+                t_start=jnp.asarray(t_start, jnp.int64),
+                t_end=jnp.asarray(t_end, jnp.int64),
+            )
+            now_dev = jnp.asarray(now_arr, jnp.int64)
         slots, ovf = sharded_conflict_query_batch(
             self.post_key,
             self.post_ent,
             self.ents,
             spec,
-            jnp.asarray(now_arr, jnp.int64),
+            now_dev,
             mesh=self.mesh,
             cap=self.cap,
             shard_results=self.shard_results,
             max_results=self.max_results,
+            replicate_out=self.multihost,
         )
         slots = np.asarray(slots)[:qn]
         ovf = np.asarray(ovf)[:qn]

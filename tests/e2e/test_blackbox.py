@@ -24,6 +24,7 @@ from __future__ import annotations
 import time
 import uuid
 
+import pytest
 import requests
 
 from tests.e2e.conftest import AUD, Proc, free_port, wait_healthy
@@ -371,10 +372,12 @@ def test_region_two_instance_interop_over_http(region_stack):
     assert r.status_code == 200, r.text
 
 
-def test_sharded_replica_surface(certs, oauth, tmp_path_factory):
-    """The --sharded_replica flag end to end: the server binary tails
-    its own WAL into a ShardedDar on an 8-virtual-device mesh and
-    serves area searches from it at /aux/v1/replica/operations."""
+@pytest.fixture(scope="module")
+def replica_stack(certs, oauth, tmp_path_factory):
+    """One server binary with --sharded_replica: it tails its own WAL
+    into per-class ShardedDars on an 8-virtual-device mesh and serves
+    /aux/v1/replica/{surface} for ALL FOUR entity classes.  The
+    fixture seeds one entity per class at the same lat."""
     wal = tmp_path_factory.mktemp("replicawal") / "dss.wal"
     port = free_port()
     p = Proc(
@@ -388,7 +391,7 @@ def test_sharded_replica_surface(certs, oauth, tmp_path_factory):
             "--sharded_replica", "2,4",
             "--replica_refresh_interval", "0.1",
             "--public_key_files", str(certs / "oauth.pem"),
-            "--accepted_jwt_audiences", "localhost",
+            "--accepted_jwt_audiences", AUD,
         ],
         "dss-replica",
     )
@@ -396,79 +399,133 @@ def test_sharded_replica_surface(certs, oauth, tmp_path_factory):
     try:
         wait_healthy(f"{base}/healthy", p.p, "dss-replica")
         lat = 46.3
-        op1 = str(uuid.uuid4())
+        op_id = str(uuid.uuid4())
         r = requests.put(
-            f"{base}/dss/v1/operation_references/{op1}",
+            f"{base}/dss/v1/operation_references/{op_id}",
             json=op_body("uss1", lat=lat),
             headers=oauth.hdr(SCD_SCOPE, sub="uss1"),
             timeout=5,
         )
         assert r.status_code == 200, r.text
-
-        area = area_str(lat=lat)
-        deadline = time.monotonic() + 120  # first mesh compile is slow
-        while True:
-            r = requests.get(
-                f"{base}/aux/v1/replica/operations",
-                params={"area": area},
-                headers=oauth.hdr(SCD_SCOPE, sub="uss1"),
-                timeout=90,
-            )
-            # a cold larger-K bucket may still be compiling: a 504
-            # (deadline) is acceptable while polling, anything else
-            # is a bug
-            if r.status_code == 504:
-                assert time.monotonic() < deadline, "compile never finished"
-                time.sleep(0.3)
-                continue
-            assert r.status_code == 200, r.text
-            body = r.json()
-            if op1 in body["operation_ids"]:
-                break
-            assert time.monotonic() < deadline, body
-            time.sleep(0.3)
-        assert body["replica"]["replica_ops_snapshot_records"] >= 1
-
-        # RID ISAs are mesh-served too (every entity class replicates)
-        isa1 = str(uuid.uuid4())
+        isa_id = str(uuid.uuid4())
         r = requests.put(
-            f"{base}/v1/dss/identification_service_areas/{isa1}",
+            f"{base}/v1/dss/identification_service_areas/{isa_id}",
             json=isa_params(lat=lat),
             headers=oauth.hdr(RID_SCOPE, sub="uss1"),
             timeout=5,
         )
         assert r.status_code == 200, r.text
-        deadline = time.monotonic() + 120
-        while True:
-            r = requests.get(
-                f"{base}/aux/v1/replica/identification_service_areas",
-                params={"area": area},
-                headers=oauth.hdr(RID_SCOPE, sub="uss1"),
-                timeout=90,
-            )
-            if r.status_code == 504:
-                assert time.monotonic() < deadline, "compile never finished"
-                time.sleep(0.3)
-                continue
-            assert r.status_code == 200, r.text
-            body = r.json()
-            if isa1 in body["service_area_ids"]:
-                break
-            assert time.monotonic() < deadline, body
-            time.sleep(0.3)
-        assert body["replica"]["replica_isas_snapshot_records"] >= 1
-
-        # auth enforced on the replica surface too
-        assert (
-            requests.get(
-                f"{base}/aux/v1/replica/operations",
-                params={"area": area},
-                timeout=5,
-            ).status_code
-            == 401
+        rid_sub_id = str(uuid.uuid4())
+        sub_params = isa_params(lat=lat)
+        del sub_params["flights_url"]
+        sub_params["callbacks"] = {
+            "identification_service_area_url":
+                "https://uss1.example.com/isa"
+        }
+        r = requests.put(
+            f"{base}/v1/dss/subscriptions/{rid_sub_id}",
+            json=sub_params,
+            headers=oauth.hdr(RID_SCOPE, sub="uss1"),
+            timeout=5,
         )
+        assert r.status_code == 200, r.text
+        scd_sub_id = str(uuid.uuid4())
+        r = requests.put(
+            f"{base}/dss/v1/subscriptions/{scd_sub_id}",
+            json={
+                "extents": scd_extent(lat=lat),
+                "uss_base_url": "https://uss1.example.com",
+                "notify_for_operations": True,
+                "old_version": 0,
+            },
+            headers=oauth.hdr(SCD_SCOPE, sub="uss1"),
+            timeout=5,
+        )
+        assert r.status_code == 200, r.text
+        yield {
+            "base": base,
+            "oauth": oauth,
+            "area": area_str(lat=lat),
+            "expect": {
+                "operations": op_id,
+                "identification_service_areas": isa_id,
+                "subscriptions": rid_sub_id,
+                "scd_subscriptions": scd_sub_id,
+            },
+        }
     finally:
         p.stop()
+
+
+# surface -> (response key, read scope, owner-scoped, stats class)
+REPLICA_SURFACES = {
+    "operations": ("operation_ids", SCD_SCOPE, False, "ops"),
+    "identification_service_areas": (
+        "service_area_ids", RID_SCOPE, False, "isas"
+    ),
+    "subscriptions": ("subscription_ids", RID_SCOPE, True, "rid_subs"),
+    "scd_subscriptions": (
+        "subscription_ids", SCD_SCOPE, True, "scd_subs"
+    ),
+}
+
+
+@pytest.mark.parametrize("surface", sorted(REPLICA_SURFACES))
+def test_sharded_replica_surface_serves_every_class(
+    replica_stack, surface
+):
+    """/aux/v1/replica/{surface} end to end for ALL FOUR entity
+    classes (api/app.py replica_surfaces): the entity written through
+    the normal API tails into the mesh replica and comes back from the
+    sharded query; subscription surfaces are owner-scoped."""
+    base, oauth = replica_stack["base"], replica_stack["oauth"]
+    area = replica_stack["area"]
+    out_key, scope, owner_scoped, stats_cls = REPLICA_SURFACES[surface]
+    want = replica_stack["expect"][surface]
+    deadline = time.monotonic() + 120  # first mesh compile is slow
+    while True:
+        r = requests.get(
+            f"{base}/aux/v1/replica/{surface}",
+            params={"area": area},
+            headers=oauth.hdr(scope, sub="uss1"),
+            timeout=90,
+        )
+        # a cold larger-K bucket may still be compiling: a 504
+        # (deadline) is acceptable while polling, anything else is a
+        # bug
+        if r.status_code == 504:
+            assert time.monotonic() < deadline, "compile never finished"
+            time.sleep(0.3)
+            continue
+        assert r.status_code == 200, r.text
+        body = r.json()
+        if want in body[out_key]:
+            break
+        assert time.monotonic() < deadline, body
+        time.sleep(0.3)
+    assert (
+        body["replica"][f"replica_{stats_cls}_snapshot_records"] >= 1
+    )
+    if owner_scoped:
+        # subscription ids are owner-private: a different owner must
+        # not see this one
+        r = requests.get(
+            f"{base}/aux/v1/replica/{surface}",
+            params={"area": area},
+            headers=oauth.hdr(scope, sub="ussother"),
+            timeout=90,
+        )
+        assert r.status_code == 200, r.text
+        assert want not in r.json()[out_key]
+    # auth enforced on every replica surface
+    assert (
+        requests.get(
+            f"{base}/aux/v1/replica/{surface}",
+            params={"area": area},
+            timeout=5,
+        ).status_code
+        == 401
+    )
 
 
 def test_region_log_server_crash_and_recovery(

@@ -49,6 +49,10 @@ def _exported_metric_names() -> set:
     from dss_tpu.region.mirror import REGION_SERVER_METRICS
 
     names |= set(REGION_SERVER_METRICS)
+    # multi-host mesh gauge family (stable name tuple next to the code)
+    from dss_tpu.parallel.multihost import MULTIHOST_METRICS
+
+    names |= set(MULTIHOST_METRICS)
     # follower + replica gauges (stats key sets are stable)
     from dss_tpu.parallel.replica import CLASSES
 
@@ -142,6 +146,36 @@ def test_grafana_dashboard_has_tier_panels():
         "tier_compact_ms_total",
     ):
         assert any(needed in e for e in exprs), needed
+
+
+def test_grafana_and_rules_cover_multihost():
+    """The multi-host mesh must stay observable: dashboard panels over
+    the dss_multihost_* family and a paging alert on degradation."""
+    dash = json.load(
+        open(os.path.join(ROOT, "deploy/grafana/dss-dashboard.json"))
+    )
+    exprs = [
+        t["expr"]
+        for p in dash["panels"]
+        for t in p.get("targets", [])
+    ]
+    for needed in (
+        "dss_multihost_degraded",
+        "dss_multihost_processes",
+        "dss_multihost_refresh_bytes",
+        "dss_multihost_last_barrier_age_s",
+    ):
+        assert any(needed in e for e in exprs), needed
+    rules = yaml.safe_load(
+        open(os.path.join(ROOT, "deploy/prometheus/rules.yaml"))
+    )
+    alerts = {
+        r.get("alert"): r["expr"]
+        for g in rules["groups"]
+        for r in g["rules"]
+    }
+    assert "DssMultihostDegraded" in alerts
+    assert "dss_multihost_degraded" in alerts["DssMultihostDegraded"]
 
 
 def test_make_certs_provisions_trust_material(tmp_path):
