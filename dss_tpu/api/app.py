@@ -168,6 +168,10 @@ def make_timeout_middleware(timeout_s: float):
         # /debug/profile deliberately runs longer than any deadline
         if request.path in ("/healthy", "/debug/profile"):
             return await handler(request)
+        # absolute per-request deadline for the serving stack: the
+        # query coalescer caps its SLO-derived item deadlines with it
+        # (_call installs it on the worker thread via dar/deadline.py)
+        request["dss_deadline"] = time.monotonic() + timeout_s
         try:
             async with timeout_ctx(timeout_s):
                 return await handler(request)
@@ -189,20 +193,26 @@ async def _call(fn, *args, request=None):
     analog of grpc-go.  When `request` is given, the per-stage sink is
     installed on the worker thread so service code's covering/store/
     serialize timings land in the request's stage breakdown."""
+    from dss_tpu.dar import deadline as _deadline
     from dss_tpu.obs import stages as _stages
 
     loop = asyncio.get_running_loop()
     sink = None if request is None else request.get("dss_stages")
+    route_dl = None if request is None else request.get("dss_deadline")
     t0 = time.perf_counter()
 
     def run():
         if sink is not None:
             _stages.set_sink(sink)
+        if route_dl is not None:
+            _deadline.set_route_deadline(route_dl)
         try:
             return fn(*args)
         finally:
             if sink is not None:
                 _stages.set_sink(None)
+            if route_dl is not None:
+                _deadline.set_route_deadline(None)
 
     try:
         return await loop.run_in_executor(None, run)
@@ -401,13 +411,17 @@ def build_app(
             # to a multi-ms numpy BFS — keep that off the event loop
             return await _call(fn, *args, request=request)
         from dss_tpu.dar import budget as _budget
+        from dss_tpu.dar import deadline as _deadline
         from dss_tpu.obs import stages as _stages
 
         sink = request.get("dss_stages")
         before = None if sink is None else dict(sink)
+        route_dl = request.get("dss_deadline")
         t0 = time.perf_counter()
         if sink is not None:
             _stages.set_sink(sink)
+        if route_dl is not None:
+            _deadline.set_route_deadline(route_dl)
         _budget.set_host_only(True)
         try:
             return fn(*args)
@@ -425,6 +439,8 @@ def build_app(
                 sink["service_ms"] = round(
                     (time.perf_counter() - t0) * 1000, 3
                 )
+            if route_dl is not None:
+                _deadline.set_route_deadline(None)
 
     def auth(request, operation: str) -> str:
         """-> owner.  No authorizer configured (unit harness) -> anon."""

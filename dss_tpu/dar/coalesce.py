@@ -10,8 +10,9 @@ DarTable.query_many batch.  Continuous batching — no timing window:
     next batch, so concurrency N collapses to ~1 kernel per round trip
     instead of N round trips.
 
-Three upgrades over the single-worker coalescer this replaces (the
-Orca-style iteration-level scheduling shape from LLM serving):
+Four upgrades over the single-worker coalescer this replaces (the
+Orca-style iteration-level scheduling shape from LLM serving, plus
+Clockwork-style predictable-latency admission):
 
   PIPELINE — the worker is split into a *pack* stage (host: key sort,
   searchsorted, window packing, async device submit via
@@ -32,9 +33,32 @@ Orca-style iteration-level scheduling shape from LLM serving):
   BACKPRESSURE — the queue is bounded (queue_depth x max_batch).  A
   full queue blocks admission briefly (admission_wait_s) and then
   sheds the request with a typed errors.OverloadedError carrying a
-  queue-drain Retry-After estimate; api/app.py maps it to HTTP 429.
-  Overload therefore degrades to bounded latency for admitted
-  requests + explicit rejections, not an unbounded backlog.
+  queue-drain Retry-After estimate from the live drain-rate EWMA;
+  api/app.py maps it to HTTP 429.  Overload therefore degrades to
+  bounded latency for admitted requests + explicit rejections, not an
+  unbounded backlog.
+
+  DEADLINE-AWARE ROUTING — every item carries an absolute deadline
+  (admission time + the DSS_CO_SLO_MS serving SLO, capped by the HTTP
+  route deadline that dar/deadline.py propagates from the timeout
+  middleware).  The coalescer keeps online EWMA cost models
+  (_CostModel: device dispatch floor, per-item device batch cost,
+  per-chunk host-scan cost — seeded at boot, updated from every
+  completed batch, exported as co_est_* gauges) and routes each
+  drained batch by PREDICTED cost against the tightest queued
+  headroom: when the fused device path (floor + batch cost + queued
+  device work) would blow that headroom, the batch is served as
+  chunked exact host scans (FastTable.query_host_chunked — the ~100 us
+  exact path, chunked to the warmed bucket) and the device kernel is
+  reserved for bulk, stale-ok, and headroom-rich batches.  The drain
+  size itself is deadline-capped (never drain more than the predicted
+  route cost fits into the minimum queued headroom), and items whose
+  deadline already expired in queue are fast-shed with a typed
+  DEADLINE_EXCEEDED error (HTTP 504) instead of occupying a kernel
+  slot.  A static size threshold put the p50<5 ms serving knee at the
+  batch-size cliff (any drain > 64 paid the ~110 ms tunneled dispatch
+  floor); measured-cost routing is what moves the knee to the host's
+  actual scan throughput.
 
 This replaces the reference's per-request SQL round trip to CRDB
 (goroutine-per-RPC, pkg/rid/cockroach/identification_service_area.go
@@ -54,16 +78,18 @@ import numpy as np
 
 from dss_tpu import errors
 from dss_tpu.dar import budget
+from dss_tpu.dar import deadline as _deadline
 from dss_tpu.obs import stages as _stages
 from dss_tpu.ops.conflict import NO_TIME_HI, NO_TIME_LO
 
 
 class _Item:
     __slots__ = ("keys", "alt_lo", "alt_hi", "t_start", "t_end", "now",
-                 "owner_id", "allow_stale", "event", "result", "error")
+                 "owner_id", "allow_stale", "deadline", "event", "result",
+                 "error")
 
     def __init__(self, keys, alt_lo, alt_hi, t_start, t_end, now, owner_id,
-                 allow_stale=False):
+                 allow_stale=False, deadline=None):
         self.keys = keys
         self.alt_lo = -np.inf if alt_lo is None else float(alt_lo)
         self.alt_hi = np.inf if alt_hi is None else float(alt_hi)
@@ -72,9 +98,136 @@ class _Item:
         self.now = int(now)
         self.owner_id = -1 if owner_id is None else int(owner_id)
         self.allow_stale = bool(allow_stale)
+        # absolute monotonic instant by which this query must complete
+        # (None = no deadline); set at admission from the SLO + the
+        # propagated route deadline, consumed by the batch router
+        self.deadline: Optional[float] = deadline
         self.event = threading.Event()
         self.result: Optional[List[str]] = None
         self.error: Optional[BaseException] = None
+
+    def expired(self, now_monotonic: float) -> bool:
+        return self.deadline is not None and self.deadline <= now_monotonic
+
+
+class _CostModel:
+    """Online EWMA cost estimates for the two serving routes.
+
+    Three scalars, seeded at boot (DSS_CO_EST_* knobs) and updated
+    from every completed batch:
+
+      est_floor_ms — the device dispatch floor: what one fused-kernel
+          round trip costs before any per-query work (tunneled ~110 ms
+          in this dev environment, sub-ms on an attached TPU).
+      est_item_ms  — marginal device cost per batched query on top of
+          the floor (device batch time modeled as floor + item * n).
+      est_chunk_ms — one warmed-bucket exact host scan
+          (FastTable.query_host_chunked serves an n-item batch as
+          ceil(n / chunk) of these).
+
+    The device pair is an exponentially-forgetting online least-squares
+    fit over observed (n, total_ms) pairs: the EWMA first/second
+    moments give slope = cov(n, t) / var(n) and floor = mean(t) -
+    slope * mean(n).  While every batch is the same size, var(n) ~ 0
+    and the seed slope stands with the floor absorbing the level (the
+    prediction AT observed sizes is exact, which is what the router
+    compares against headroom); mixed sizes disambiguate the split."""
+
+    __slots__ = ("alpha", "chunk", "est_floor_ms", "est_item_ms",
+                 "est_chunk_ms", "device_obs", "host_obs",
+                 "_sn", "_st", "_snn", "_snt")
+
+    def __init__(self, *, floor_ms: float = 20.0, item_ms: float = 0.02,
+                 chunk_ms: float = 0.3, chunk: int = 64,
+                 alpha: float = 0.2):
+        self.alpha = float(alpha)
+        self.chunk = max(1, int(chunk))
+        self.est_floor_ms = float(floor_ms)
+        self.est_item_ms = float(item_ms)
+        self.est_chunk_ms = float(chunk_ms)
+        self.device_obs = 0
+        self.host_obs = 0
+        # EWMA moments of (n, total_ms) for the device fit, primed
+        # from the seed (at a representative batch size) so the first
+        # observations BLEND into the seeded estimate instead of
+        # replacing it wholesale
+        n0 = float(4 * self.chunk)
+        t0 = self.est_floor_ms + self.est_item_ms * n0
+        self._sn = n0
+        self._st = t0
+        self._snn = n0 * n0
+        self._snt = n0 * t0
+
+    def _chunks(self, n: int) -> int:
+        return max(1, -(-int(n) // self.chunk))
+
+    def observe_device(self, n: int, total_ms: float) -> None:
+        a = self.alpha
+        n = float(max(1, n))
+        # winsorize: one outlier batch (an unwarmed-bucket XLA compile
+        # can cost seconds vs a ~100 ms floor) must not poison the
+        # floor estimate — under fresh-SLO-only traffic a poisoned-high
+        # floor routes everything hostward and the device is never
+        # re-sampled to correct it.  Clamping each observation to 4x
+        # the current prediction bounds a single outlier's pull while
+        # a GENUINE floor shift still converges (the clamp ratchets up
+        # with the prediction each step).
+        total_ms = min(
+            float(total_ms), 4.0 * max(self.predict_device_ms(n), 0.05)
+        )
+        self._sn += a * (n - self._sn)
+        self._st += a * (total_ms - self._st)
+        self._snn += a * (n * n - self._snn)
+        self._snt += a * (n * total_ms - self._snt)
+        var = self._snn - self._sn * self._sn
+        if var > 1e-6 * max(self._snn, 1.0):
+            self.est_item_ms = max(
+                0.0, (self._snt - self._sn * self._st) / var
+            )
+        # else: single-size traffic so far — keep the seeded slope
+        self.est_floor_ms = max(
+            0.05, self._st - self.est_item_ms * self._sn
+        )
+        self.device_obs += 1
+
+    def observe_host(self, n: int, total_ms: float) -> None:
+        per = total_ms / self._chunks(n)
+        self.est_chunk_ms += self.alpha * (per - self.est_chunk_ms)
+        self.host_obs += 1
+
+    def predict_device_ms(self, n: int, inflight: int = 0) -> float:
+        # batches already in the device stream must clear first; with
+        # the double-buffered pipeline each adds ~a floor of wait
+        return (
+            self.est_floor_ms * (1 + max(0, int(inflight)))
+            + self.est_item_ms * n
+        )
+
+    def predict_host_ms(self, n: int, inflight_chunks: int = 0,
+                        inflight_device: int = 0) -> float:
+        # work already queued at the single collect thread serializes
+        # ahead of this batch: forced host chunks scan there, and a
+        # pending DEVICE batch blocks it in wait_device() for ~a floor
+        # — without both terms a host batch behind a predecessor would
+        # be predicted at a fraction of its real completion
+        return (
+            (self._chunks(n) + max(0, int(inflight_chunks)))
+            * self.est_chunk_ms
+            + max(0, int(inflight_device)) * self.est_floor_ms
+        )
+
+    def host_qps(self) -> float:
+        """Host-chunk route drain throughput estimate."""
+        return self.chunk / max(self.est_chunk_ms, 1e-3) * 1000.0
+
+    def min_route_qps(self, n: int) -> float:
+        """Conservative drain throughput at drain size n: the SLOWER
+        of the two routes — the Retry-After fallback before any drain
+        has been measured (a cold-start overload may be bulk/stale
+        traffic that drains at device-floor-limited rates, so quoting
+        the host route's throughput would invite a retry storm)."""
+        dev = n / max(self.predict_device_ms(n), 1e-3) * 1000.0
+        return min(self.host_qps(), dev)
 
 
 class _BatchController:
@@ -115,6 +268,41 @@ class _BatchController:
             self.cur = min(self.max_batch, self.cur * 2)
             self.grows += 1
 
+    def drain_cap(
+        self, headroom_ms: Optional[float], cost: _CostModel,
+        inflight: int, inflight_host_chunks: int = 0,
+    ) -> int:
+        """Deadline-aware drain bound: never drain more than the
+        predicted route cost fits into the minimum queued headroom.
+        With rich headroom (the device route fits inside the budget)
+        the AIMD size stands; under pressure — and only when the host
+        route is the one that will actually be chosen (same
+        _HEADROOM_SAFETY budget as _choose_host_route, so the two
+        decisions cannot disagree) — the drain shrinks to the host
+        chunks that fit, never below one warmed chunk (forward
+        progress — a zero cap would starve the queue entirely)."""
+        if headroom_ms is None:
+            return self.cur
+        budget_ms = _HEADROOM_SAFETY * max(0.0, headroom_ms)
+        pred_dev = cost.predict_device_ms(self.cur, inflight)
+        if pred_dev <= budget_ms:
+            return self.cur
+        if (
+            cost.predict_host_ms(self.cur, inflight_host_chunks, inflight)
+            >= pred_dev
+        ):
+            # the device is the lesser evil even over budget: shrinking
+            # the drain would only pay MORE dispatch floors
+            return self.cur
+        fit = (
+            int(
+                (budget_ms - inflight * cost.est_floor_ms)
+                / max(cost.est_chunk_ms, 1e-3)
+            )
+            - max(0, int(inflight_host_chunks))
+        )
+        return max(cost.chunk, min(self.cur, cost.chunk * max(1, fit)))
+
 
 def _env_bool(v: str) -> bool:
     s = v.strip().lower()
@@ -138,6 +326,13 @@ def env_knobs() -> dict:
         ("DSS_CO_ADMISSION_WAIT_S", "admission_wait_s", float),
         ("DSS_CO_PIPELINE_DEPTH", "pipeline_depth", int),
         ("DSS_CO_INLINE", "inline", _env_bool),
+        # deadline-aware routing: the per-query serving SLO (0 disables
+        # SLO-derived deadlines; route deadlines still apply) and the
+        # boot seeds of the EWMA cost models
+        ("DSS_CO_SLO_MS", "slo_ms", float),
+        ("DSS_CO_EST_FLOOR_MS", "est_floor_ms", float),
+        ("DSS_CO_EST_ITEM_MS", "est_item_ms", float),
+        ("DSS_CO_EST_CHUNK_MS", "est_chunk_ms", float),
     ):
         raw = os.environ.get(env)
         if raw is not None:
@@ -150,6 +345,12 @@ def env_knobs() -> dict:
 
 # inflight-queue sentinel: tells the collect stage to exit
 _DONE = object()
+
+# fraction of a batch's tightest headroom the router budgets for the
+# serving route itself (the rest covers decode + caller wake).  Shared
+# by _BatchController.drain_cap and _choose_host_route so the drain
+# sizing and the route choice can never disagree about the budget.
+_HEADROOM_SAFETY = 0.5
 
 
 class QueryCoalescer:
@@ -167,6 +368,16 @@ class QueryCoalescer:
         admission_wait_s: float = 0.25,
         pipeline_depth: int = 2,
         inline: bool = True,
+        slo_ms: float = 0.0,  # 0 = no SLO-derived deadlines: items
+        #   carry only the propagated route deadline.  Deployments
+        #   chasing a joint qps+latency target set DSS_CO_SLO_MS (the
+        #   bench legs run with 50 ms) — the router only ever forces
+        #   the host route under REAL deadline pressure, so the
+        #   conservative default cannot regress bulk throughput.
+        est_floor_ms: float = 20.0,
+        est_item_ms: float = 0.02,
+        est_chunk_ms: float = 0.3,
+        clock=time.monotonic,  # injectable for fake-clock routing tests
     ):
         self._table = table
         self._cond = threading.Condition()
@@ -175,6 +386,10 @@ class QueryCoalescer:
         self._busy = False  # an inline batch is executing on a caller
         self._packing = False  # the pack stage is mid-drain
         self._inflight = 0  # packed batches not yet collected
+        self._inflight_items = 0  # queries inside those batches
+        self._inflight_device = 0  # of those batches: on the device
+        self._inflight_host_chunks = 0  # forced-host chunks queued at
+        #                                 the collect thread
         self._ctl = _BatchController(
             min_batch=min_batch, max_batch=max_batch,
             target_ms=target_batch_ms,
@@ -183,6 +398,23 @@ class QueryCoalescer:
         self._max_queue = self._queue_depth * self._ctl.max_batch
         self._admission_wait_s = float(admission_wait_s)
         self._inline = bool(inline)
+        self._clock = clock
+        # per-query serving SLO: each admitted item must complete
+        # within slo_ms (capped by the propagated route deadline);
+        # 0 disables SLO-derived deadlines
+        self._slo_ms = float(slo_ms)
+        # the host-chunk bucket mirrors the warmed host-path width
+        # every table serves chunks at (FastTable.HOST_MAX_BATCH)
+        try:
+            from dss_tpu.ops.fastpath import FastTable as _FT
+
+            chunk = _FT.HOST_MAX_BATCH
+        except Exception:  # pragma: no cover
+            chunk = 64
+        self._cost = _CostModel(
+            floor_ms=est_floor_ms, item_ms=est_item_ms,
+            chunk_ms=est_chunk_ms, chunk=chunk,
+        )
         self._inflight_q: _queue.Queue = _queue.Queue(
             maxsize=max(1, int(pipeline_depth))
         )
@@ -194,6 +426,10 @@ class QueryCoalescer:
         self._stat_items = 0
         self._stat_inline = 0
         self._stat_shed = 0
+        self._stat_deadline_shed = 0
+        self._stat_route_host = 0  # batches fully served on the host
+        self._stat_route_hostchunk = 0  # of those: forced chunked route
+        self._stat_route_device = 0  # batches that touched the device
         self._stat_pack_ms = 0.0
         self._stat_device_ms = 0.0
         self._stat_collect_ms = 0.0
@@ -227,10 +463,13 @@ class QueryCoalescer:
         queue_depth: Optional[int] = None,
         admission_wait_s: Optional[float] = None,
         inline: Optional[bool] = None,
+        slo_ms: Optional[float] = None,
     ) -> None:
         """Adjust serving knobs at runtime (ops endpoint / tests).
         Pipeline depth is fixed at construction (the double buffer)."""
         with self._cond:
+            if slo_ms is not None:
+                self._slo_ms = float(slo_ms)
             if min_batch is not None:
                 self._ctl.min_batch = int(min_batch)
             if max_batch is not None:
@@ -267,13 +506,19 @@ class QueryCoalescer:
             self._collect_thread.start()
 
     def _retry_after_locked(self) -> float:
-        """Queue-drain horizon estimate for the 429 Retry-After."""
-        backlog = len(self._queue) + self._inflight * self._ctl.cur
-        if self._ema_qps > 1.0:
-            est = backlog / self._ema_qps
-        else:
-            est = 1.0
-        return min(5.0, max(0.05, est))
+        """Queue-drain horizon estimate for the 429 Retry-After: live
+        backlog (queued + actually in-flight items, not a batch-size
+        guess) over the measured drain-rate EWMA.  Before any drain
+        has been observed, the cost model's SLOWER-route throughput at
+        the current drain size stands in — an honest model-derived
+        floor rather than a static 1 s guess (quoting the fast host
+        route during a device-bound cold-start overload would invite
+        a synchronized retry storm)."""
+        backlog = len(self._queue) + self._inflight_items
+        qps = self._ema_qps
+        if qps <= 1.0:
+            qps = max(1.0, self._cost.min_route_qps(self._ctl.cur))
+        return min(5.0, max(0.05, backlog / qps))
 
     def query(
         self,
@@ -293,9 +538,31 @@ class QueryCoalescer:
         keys = np.asarray(keys, np.int32).ravel()
         if len(keys) == 0:
             return []
+        # deadline at admission: the serving SLO from "now" (queue wait
+        # counts against it), capped by the route deadline the HTTP
+        # timeout middleware propagated.  Bounded-staleness queries
+        # carry only the route deadline — they are explicitly latency-
+        # tolerant, so they never drag a batch onto the host route.
+        route_dl = _deadline.get_route_deadline()
+        if allow_stale or self._slo_ms <= 0:
+            dl = route_dl
+        else:
+            dl = self._clock() + self._slo_ms / 1000.0
+            if route_dl is not None:
+                dl = min(dl, route_dl)
+        if dl is not None and dl <= self._clock():
+            # the route deadline was consumed before the query reached
+            # the store (slow auth/parse/covering): shed NOW — the
+            # inline path would otherwise run a scan whose response
+            # the timeout middleware has already replaced with a 504
+            with self._slock:
+                self._stat_deadline_shed += 1
+            raise errors.deadline_exceeded(
+                "request deadline expired before query admission"
+            )
         item = _Item(
             keys, alt_lo, alt_hi, t_start, t_end, now, owner_id,
-            allow_stale,
+            allow_stale, deadline=dl,
         )
         inline = False
         deadline = None
@@ -346,8 +613,15 @@ class QueryCoalescer:
                     )
                 self._cond.wait(deadline - t_mono)
         if inline:
+            # the lone-caller shortcut must not bypass the router: an
+            # idle-server fresh query whose candidates overflow the
+            # auto host cap would otherwise ride the device dispatch
+            # floor and blow the very SLO the router protects
+            hr = None
+            if item.deadline is not None and not item.allow_stale:
+                hr = max(0.0, (item.deadline - self._clock()) * 1000.0)
             try:
-                self._execute([item])
+                self._execute([item], headroom_ms=hr)
                 with self._slock:
                     self._stat_inline += 1
             finally:
@@ -393,11 +667,77 @@ class QueryCoalescer:
             and all(it.allow_stale and it.owner_id < 0 for it in batch)
         )
 
+    def _drain_locked(self):
+        """Pop the next drain off the queue (caller holds _cond):
+        -> (batch, expired, headroom_ms).  Items whose deadline already
+        passed are split out for fast-shedding; headroom_ms is the
+        tightest remaining deadline among the drainable fresh items
+        (None when none carries a deadline — e.g. an all-stale-ok
+        drain); the drain size is the AIMD controller output bounded
+        by what the predicted route cost fits into that headroom."""
+        now_m = self._clock()
+        look = self._queue[: self._ctl.cur]
+        headroom_ms = None
+        for it in look:
+            if (
+                it.deadline is not None
+                and not it.allow_stale
+                and not it.expired(now_m)
+            ):
+                h = (it.deadline - now_m) * 1000.0
+                if headroom_ms is None or h < headroom_ms:
+                    headroom_ms = h
+        cap = self._ctl.drain_cap(
+            headroom_ms, self._cost, self._inflight_device,
+            self._inflight_host_chunks,
+        )
+        batch: List[_Item] = []
+        expired: List[_Item] = []
+        taken = 0
+        for it in look:
+            if it.expired(now_m):
+                expired.append(it)
+                taken += 1
+                continue
+            if len(batch) >= cap:
+                break
+            batch.append(it)
+            taken += 1
+        del self._queue[:taken]
+        return batch, expired, headroom_ms
+
+    def _choose_host_route(self, batch, headroom_ms) -> bool:
+        """The routing policy: serve this drain as chunked exact host
+        scans when the predicted device completion (dispatch floor +
+        per-size batch cost + queued device work) would blow the
+        tightest queued headroom budget (_HEADROOM_SAFETY of it — the
+        same budget drain_cap sizes against) AND the host chunks are
+        predicted to finish sooner.  Bulk/stale-ok/headroom-rich
+        batches keep the fused device kernel (headroom_ms is None for
+        those)."""
+        if headroom_ms is None:
+            return False
+        pred_dev = self._cost.predict_device_ms(
+            len(batch), self._inflight_device
+        )
+        if pred_dev <= _HEADROOM_SAFETY * headroom_ms:
+            return False
+        return (
+            self._cost.predict_host_ms(
+                len(batch), self._inflight_host_chunks,
+                self._inflight_device,
+            )
+            < pred_dev
+        )
+
     def _pack_loop(self):
-        """Stage 1: drain the queue, pack windows on the host, start
-        the device kernel asynchronously.  Hands (batch, pending) to
-        the collect stage through a bounded double buffer, so pack of
-        batch N+1 overlaps device execution + decode of batch N."""
+        """Stage 1: drain the queue (deadline-capped), fast-shed
+        expired items, route the batch (host chunks vs fused device
+        kernel) by predicted cost vs headroom, pack windows on the
+        host, start any device kernel asynchronously.  Hands
+        (batch, pending) to the collect stage through a bounded double
+        buffer, so pack of batch N+1 overlaps device execution +
+        decode of batch N."""
         while True:
             with self._cond:
                 # also wait while an inline batch is executing: its
@@ -406,39 +746,85 @@ class QueryCoalescer:
                     self._cond.wait()
                 if self._closed and not self._queue:
                     break
-                n = min(len(self._queue), self._ctl.cur)
-                batch = self._queue[:n]
-                del self._queue[:n]
+                batch, expired, headroom_ms = self._drain_locked()
                 self._packing = True
                 self._inflight += 1
+                self._inflight_items += len(batch)
                 # queue space just opened: wake admission waiters
                 self._cond.notify_all()
-            t0 = time.perf_counter()
-            pq = None
-            kind = "exec"
-            try:
-                if not self._mesh_eligible(batch):
-                    submit = getattr(self._table, "query_many_submit", None)
-                    if submit is not None:
-                        keys, lo, hi, t0s, t1s, now, owners = (
-                            self._pack_args(batch)
-                        )
-                        pq = submit(
-                            keys, lo, hi, t0s, t1s,
-                            now=now, owner_ids=owners,
-                        )
-                        kind = "table"
-            except BaseException as e:  # noqa: BLE001 — deliver to callers
-                self._deliver_error(batch, e)
+            if expired:
+                # deadline expired while queued: typed 504 now, not a
+                # wasted kernel slot later
+                self._deliver_error(
+                    expired,
+                    errors.deadline_exceeded(
+                        "request deadline expired in the serving queue"
+                    ),
+                )
+                with self._slock:
+                    self._stat_deadline_shed += len(expired)
+            if not batch:
                 with self._cond:
                     self._packing = False
                     self._inflight -= 1
                     self._cond.notify_all()
                 continue
+            t0 = time.perf_counter()
+            pq = None
+            kind = "exec"
+            host_route = False
+            used_device = False
+            try:
+                if not self._mesh_eligible(batch):
+                    submit = getattr(self._table, "query_many_submit", None)
+                    if submit is not None:
+                        host_route = self._choose_host_route(
+                            batch, headroom_ms
+                        )
+                        if host_route:
+                            # forced chunked host scans execute on the
+                            # COLLECT stage: running them here would
+                            # serialize the two-stage pipeline exactly
+                            # when deadline pressure needs it most
+                            # (pack keeps draining while collect scans)
+                            kind = "hostchunk"
+                        else:
+                            keys, lo, hi, t0s, t1s, now, owners = (
+                                self._pack_args(batch)
+                            )
+                            pq = submit(
+                                keys, lo, hi, t0s, t1s,
+                                now=now, owner_ids=owners,
+                                host_route=False,
+                            )
+                            kind = "table"
+                            used_device = self._pq_used_device(pq)
+            except BaseException as e:  # noqa: BLE001 — deliver to callers
+                self._deliver_error(batch, e)
+                with self._cond:
+                    self._packing = False
+                    self._inflight -= 1
+                    self._inflight_items -= len(batch)
+                    self._cond.notify_all()
+                continue
             pack_ms = (time.perf_counter() - t0) * 1000
+            if used_device or kind == "hostchunk":
+                # count the pressure BEFORE the handoff: the collect
+                # thread decrements after processing, so incrementing
+                # after put() could briefly hide in-flight work from
+                # the router's predictions
+                with self._cond:
+                    if used_device:
+                        self._inflight_device += 1
+                    else:
+                        self._inflight_host_chunks += (
+                            self._cost._chunks(len(batch))
+                        )
             # bounded handoff: blocks when the collect stage is
             # pipeline_depth batches behind (the double buffer)
-            self._inflight_q.put((batch, kind, pq, pack_ms))
+            self._inflight_q.put(
+                (batch, kind, pq, pack_ms, host_route, used_device)
+            )
             with self._cond:
                 self._packing = False
         # shutdown sentinel — put OUTSIDE the condition lock: the
@@ -450,24 +836,47 @@ class QueryCoalescer:
 
     def _collect_loop(self):
         """Stage 2: wait for the device, decode, deliver results, and
-        feed the batch-size controller."""
+        feed the batch-size controller + the route cost models."""
         while True:
             handoff = self._inflight_q.get()
             if handoff is _DONE:
                 return
-            batch, kind, pq, pack_ms = handoff
+            batch, kind, pq, pack_ms, host_route, used_device = handoff
             t0 = time.perf_counter()
             t1 = t0
             device_ms = 0.0
+            # what the batch ACTUALLY rode (a forced host batch can
+            # fall back to the device per tier); used_device keeps the
+            # pack-time accounting for the pressure-counter decrement
+            observed_device = used_device
             try:
                 if kind == "table":
                     pq.wait_device()
                     t1 = time.perf_counter()
                     device_ms = (t1 - t0) * 1000
-                    results = self._table.query_many_collect(pq)
-                    for it, res in zip(batch, results):
-                        it.result = res
-                        it.event.set()
+                    self._deliver_results(
+                        batch, self._table.query_many_collect(pq)
+                    )
+                elif kind == "hostchunk":
+                    # the deadline router's forced route, deferred here
+                    # so it overlaps the pack of the next drain.  Run
+                    # the split halves: a tier whose chunks overflow
+                    # the raised candidate cap silently rides the
+                    # device, and that outcome must be OBSERVED (fed to
+                    # the device model, counted as a device batch) or
+                    # one fallback would poison est_chunk_ms with a
+                    # dispatch floor and mislabel the route mix
+                    keys, lo, hi, t0s, t1s, now, owners = (
+                        self._pack_args(batch)
+                    )
+                    pq = self._table.query_many_submit(
+                        keys, lo, hi, t0s, t1s,
+                        now=now, owner_ids=owners, host_route=True,
+                    )
+                    observed_device = self._pq_used_device(pq)
+                    self._deliver_results(
+                        batch, self._table.query_many_collect(pq)
+                    )
                 else:
                     # mesh-eligible (or submit-less table): the full
                     # synchronous path, mesh-first with local fallback
@@ -483,6 +892,23 @@ class QueryCoalescer:
                 self._stat_device_ms += device_ms
                 self._stat_collect_ms += collect_ms
                 self._stat_last_batch = len(batch)
+                if kind in ("table", "hostchunk"):
+                    # feed the EWMA cost models with the measured
+                    # end-to-end batch cost (what a queued caller pays)
+                    if observed_device:
+                        self._stat_route_device += 1
+                        self._cost.observe_device(len(batch), total_ms)
+                    else:
+                        self._stat_route_host += 1
+                        if host_route:
+                            self._stat_route_hostchunk += 1
+                        if host_route or len(batch) >= self._cost.chunk:
+                            # tiny auto-host batches cost one SCAN, not
+                            # one warmed 64-wide CHUNK — feeding them
+                            # in would train est_chunk_ms to ~a point
+                            # lookup and make the first pressure burst
+                            # over-drain its headroom
+                            self._cost.observe_host(len(batch), total_ms)
                 if total_ms > 0:
                     inst = len(batch) / (total_ms / 1000.0)
                     self._ema_qps = (
@@ -492,6 +918,13 @@ class QueryCoalescer:
             with self._cond:
                 self._ctl.observe(len(batch), total_ms)
                 self._inflight -= 1
+                self._inflight_items -= len(batch)
+                if used_device:
+                    self._inflight_device -= 1
+                elif kind == "hostchunk":
+                    self._inflight_host_chunks -= self._cost._chunks(
+                        len(batch)
+                    )
                 self._cond.notify_all()
 
     @staticmethod
@@ -500,6 +933,21 @@ class QueryCoalescer:
             if not it.event.is_set():
                 it.error = e
                 it.event.set()
+
+    @staticmethod
+    def _deliver_results(batch: List[_Item], results) -> None:
+        for it, res in zip(batch, results):
+            it.result = res
+            it.event.set()
+
+    @staticmethod
+    def _pq_used_device(pq) -> bool:
+        """Did this submitted batch touch the device?  (A forced host
+        batch can still fall back per tier on candidate-cap overflow —
+        the router's accounting must see what actually happened.)"""
+        return pq is not None and any(
+            p is not None for p in getattr(pq, "tier_pending", ())
+        )
 
     @staticmethod
     def _pack_args(batch: List[_Item]):
@@ -517,7 +965,7 @@ class QueryCoalescer:
 
     # -- synchronous execution (inline path + mesh batches) -------------------
 
-    def _execute(self, batch: List[_Item]):
+    def _execute(self, batch: List[_Item], headroom_ms=None):
         try:
             b = len(batch)
             if (
@@ -553,12 +1001,42 @@ class QueryCoalescer:
                         "mesh offload failed; serving batch locally"
                     )
             keys, lo, hi, t0s, t1s, now, owners = self._pack_args(batch)
-            results = self._table.query_many(
-                keys, lo, hi, t0s, t1s, now=now, owner_ids=owners,
+            # never force the 4x-raised-cap chunk scans onto a
+            # host-only caller (the event loop's inline-read budget):
+            # the auto path's 2^16 cap stays the loop's worst case,
+            # anything bigger raises NeedsDevice and re-routes on the
+            # executor where the router applies normally
+            host_route = (
+                not budget.is_host_only()
+                and self._choose_host_route(batch, headroom_ms)
             )
-            for it, res in zip(batch, results):
-                it.result = res
-                it.event.set()
+            submit = getattr(self._table, "query_many_submit", None)
+            t0 = time.perf_counter()
+            used_device = None
+            if submit is not None:
+                # run the split halves so the chosen route is
+                # observable: inline traffic must feed the cost models
+                # too, or a low-load deployment would route on the
+                # boot seed forever
+                pq = submit(
+                    keys, lo, hi, t0s, t1s, now=now, owner_ids=owners,
+                    host_route=host_route,
+                )
+                used_device = self._pq_used_device(pq)
+                results = self._table.query_many_collect(pq)
+            else:
+                results = self._table.query_many(
+                    keys, lo, hi, t0s, t1s, now=now, owner_ids=owners,
+                    host_route=host_route,
+                )
+            if used_device is not None:
+                total_ms = (time.perf_counter() - t0) * 1000
+                with self._slock:
+                    if used_device:
+                        self._cost.observe_device(b, total_ms)
+                    elif host_route or b >= self._cost.chunk:
+                        self._cost.observe_host(b, total_ms)
+            self._deliver_results(batch, results)
         except BaseException as e:  # noqa: BLE001 — deliver to callers
             self._deliver_error(batch, e)
 
@@ -573,9 +1051,11 @@ class QueryCoalescer:
                 "co_queue_depth": len(self._queue),
                 "co_queue_cap": self._max_queue,
                 "co_inflight": self._inflight,
+                "co_inflight_items": self._inflight_items,
                 "co_batch_size": self._ctl.cur,
                 "co_batch_grows": self._ctl.grows,
                 "co_batch_shrinks": self._ctl.shrinks,
+                "co_slo_ms": self._slo_ms,
             }
         with self._slock:
             out.update(
@@ -583,11 +1063,19 @@ class QueryCoalescer:
                 co_items=self._stat_items,
                 co_inline=self._stat_inline,
                 co_shed=self._stat_shed,
+                co_deadline_shed=self._stat_deadline_shed,
+                co_route_host_batches=self._stat_route_host,
+                co_route_hostchunk_batches=self._stat_route_hostchunk,
+                co_route_device_batches=self._stat_route_device,
                 co_pack_ms_total=round(self._stat_pack_ms, 3),
                 co_device_ms_total=round(self._stat_device_ms, 3),
                 co_collect_ms_total=round(self._stat_collect_ms, 3),
                 co_last_batch=self._stat_last_batch,
                 co_ema_qps=round(self._ema_qps, 1),
+                # live cost-model estimates (the router's inputs)
+                co_est_device_floor_ms=round(self._cost.est_floor_ms, 4),
+                co_est_device_item_ms=round(self._cost.est_item_ms, 5),
+                co_est_host_chunk_ms=round(self._cost.est_chunk_ms, 4),
             )
         out["mesh_offloads"] = self.mesh_offloads
         return out

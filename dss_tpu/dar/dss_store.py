@@ -961,7 +961,8 @@ class DSSStore:
     def configure_serving(self, **knobs) -> None:
         """Fan serving-pipeline knobs (QueryCoalescer.configure:
         min_batch / max_batch / target_batch_ms / queue_depth /
-        admission_wait_s / inline) out to every entity class's
+        admission_wait_s / inline / slo_ms — the per-query serving SLO
+        driving the deadline router) out to every entity class's
         coalescer.  Boot-time defaults come from DSS_CO_* env vars
         (coalesce.env_knobs); this is the runtime override for ops
         tuning and tests.  No-op on the memory backend."""

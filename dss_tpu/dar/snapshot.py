@@ -662,13 +662,23 @@ class DarTable:
         now,  # int scalar or i64[B] per-query
         owner_ids: Optional[np.ndarray] = None,  # i32[B], -1 = no filter
         state: Optional[_State] = None,  # pre-grabbed state (internal)
+        host_route: bool = False,  # force chunked exact host scans
     ) -> Optional[_PendingQuery]:
         """The host/pack half of query_many: grab ONE immutable state,
         pack the query batch, and either answer small batches from the
         exact host postings copy or enqueue the fused device kernel
         (async — nothing here blocks on the device).  Returns a handle
         for query_many_collect; None for an empty batch.  Pipelined
-        callers overlap this with a previous batch's collect."""
+        callers overlap this with a previous batch's collect.
+
+        host_route=True is the deadline router's forced path (the
+        QueryCoalescer under deadline pressure): every tier is served
+        as chunked exact host scans (FastTable.query_host_chunked, the
+        warmed HOST_MAX_BATCH bucket per chunk) instead of the fused
+        device kernel — bit-identical results, no device round trip.
+        A tier whose chunks exceed the raised host-candidate cap falls
+        back to the device submit for that tier only (correctness over
+        routing intent)."""
         st = state if state is not None else self._state
         b = len(keys_list)
         if b == 0:
@@ -699,9 +709,14 @@ class DarTable:
             if tier.snap.fast is None:
                 tier_host.append(None)
                 continue
-            host = tier.snap.fast.query_host_auto(
-                qkeys, alt_lo, alt_hi, t_start, t_end, now=now_arr
-            )
+            if host_route:
+                host = tier.snap.fast.query_host_chunked(
+                    qkeys, alt_lo, alt_hi, t_start, t_end, now=now_arr
+                )
+            else:
+                host = tier.snap.fast.query_host_auto(
+                    qkeys, alt_lo, alt_hi, t_start, t_end, now=now_arr
+                )
             tier_host.append(host)
             if host is None:
                 need_device.append(ti)
@@ -773,6 +788,7 @@ class DarTable:
         now,  # int scalar or i64[B] per-query
         owner_ids: Optional[np.ndarray] = None,  # i32[B], -1 = no filter
         state: Optional[_State] = None,  # pre-grabbed state (internal)
+        host_route: bool = False,  # force chunked exact host scans
     ) -> List[List[str]]:
         """Batched search via the fused fast path + overlay scan.
         Lock-free: runs against ONE atomically-grabbed immutable state.
@@ -782,6 +798,7 @@ class DarTable:
             self.query_many_submit(
                 keys_list, alt_lo, alt_hi, t_start, t_end,
                 now=now, owner_ids=owner_ids, state=state,
+                host_route=host_route,
             )
         )
 

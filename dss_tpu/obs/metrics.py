@@ -9,6 +9,10 @@ Istio; the Go services expose nothing — SURVEY.md §5).  Exposes:
   dss_dar_<class>_tier_*                         tiered-snapshot gauges
       (tier sizes, shadowed rows, minor-fold vs major-compaction
       counts/durations — DarTable.stats via the index stats)
+  dss_dar_<class>_co_*                           serving-pipeline gauges
+      (queue/batch/stage series plus the deadline router's route-mix
+      counters, co_deadline_shed, and the co_est_* live cost-model
+      estimates — QueryCoalescer.stats via the index stats)
 
 Route labels are templatized (UUID path segments -> ":id") to bound
 cardinality.  Scrape at GET /metrics.

@@ -758,22 +758,79 @@ class FastTable:
     # round trip and win on the device.
     HOST_MAX_BATCH = 64
     HOST_MAX_CANDIDATES = 1 << 16
+    # the deadline router's FORCED host route (query_host_chunked):
+    # batches beyond HOST_MAX_BATCH are served as chunks of the warmed
+    # HOST_MAX_BATCH bucket with a raised per-chunk candidate cap — a
+    # deliberate latency-for-CPU trade when the device round trip would
+    # blow a request deadline.  Beyond the raised cap the chunk really
+    # is device-shaped work (a multi-ms host scan) and the route
+    # declines (returns None) so the caller falls back to the kernel.
+    HOST_ROUTE_MAX_CANDIDATES = 1 << 18
 
-    def host_candidates(self, qkeys: np.ndarray):
+    def host_candidates(self, qkeys: np.ndarray, *,
+                        max_batch: Optional[int] = None,
+                        max_candidates: Optional[int] = None):
         """-> (lo, hi) postings ranges for the batch, or None when the
         batch should go to the device (too big).  Thread-safe: ranges
-        are returned, not cached (readers are lock-free)."""
-        if len(qkeys) > self.HOST_MAX_BATCH or self.slot_exact is None:
+        are returned, not cached (readers are lock-free).  max_batch /
+        max_candidates override the auto-route gates (the deadline
+        router's forced host chunks raise them)."""
+        mb = self.HOST_MAX_BATCH if max_batch is None else int(max_batch)
+        if len(qkeys) > mb or self.slot_exact is None:
             return None
+        mc = (
+            self.HOST_MAX_CANDIDATES
+            if max_candidates is None
+            else int(max_candidates)
+        )
         lo, hi = self._range_lookup(
             np.ascontiguousarray(qkeys, np.int32).ravel()
         )
-        if int((hi - lo).sum()) > self.HOST_MAX_CANDIDATES:
+        if int((hi - lo).sum()) > mc:
             return None
         return lo, hi
 
+    def query_host_chunked(
+        self, qkeys, alt_lo, alt_hi, t_start, t_end, *, now,
+        chunk: Optional[int] = None,
+    ):
+        """FORCED exact host answer for batches of any size: rows are
+        served in chunks of the warmed HOST_MAX_BATCH bucket (the size
+        every boot-warmed native/numpy scan already runs at), each with
+        the raised HOST_ROUTE_MAX_CANDIDATES cap.  -> (qidx, slots)
+        bit-identical to the fused device path, or None when any chunk
+        exceeds the raised cap (then the batch is genuinely device
+        work).  This is the deadline router's escape hatch from the
+        device dispatch floor: N/64 sequential ~100 us scans beat one
+        ~100 ms tunneled round trip for every mid-size burst."""
+        if self.slot_exact is None:
+            return None
+        b = len(qkeys)
+        step = self.HOST_MAX_BATCH if chunk is None else max(1, int(chunk))
+        now_b = np.broadcast_to(np.asarray(now, np.int64), (b,))
+        parts_q: List[np.ndarray] = []
+        parts_s: List[np.ndarray] = []
+        for s in range(0, b, step):
+            e = min(b, s + step)
+            res = self.query_host_auto(
+                qkeys[s:e], alt_lo[s:e], alt_hi[s:e],
+                t_start[s:e], t_end[s:e], now=now_b[s:e],
+                max_batch=step,
+                max_candidates=self.HOST_ROUTE_MAX_CANDIDATES,
+            )
+            if res is None:
+                return None
+            qi, sl = res
+            parts_q.append(qi + s)
+            parts_s.append(sl)
+        if not parts_q:
+            return np.zeros(0, np.int64), np.zeros(0, np.int64)
+        return np.concatenate(parts_q), np.concatenate(parts_s)
+
     def query_host_auto(
         self, qkeys, alt_lo, alt_hi, t_start, t_end, *, now,
+        max_batch: Optional[int] = None,
+        max_candidates: Optional[int] = None,
     ):
         """Exact host-path answer for small batches: (qidx, slots), or
         None when the batch should go to the device.  Prefers the
@@ -781,8 +838,11 @@ class FastTable:
         numpy dispatches (~0.2 ms -> ~15 us at 1k entities, ~3 ms ->
         ~60 us at 1M); identical verdicts (same compares on the same
         values), pinned by tests/test_native_hostquery.py.  Falls back
-        to the numpy path when the lib is absent."""
-        if len(qkeys) > self.HOST_MAX_BATCH or self.slot_exact is None:
+        to the numpy path when the lib is absent.  max_batch /
+        max_candidates raise the route gates for the deadline router's
+        forced host chunks (query_host_chunked)."""
+        mb = self.HOST_MAX_BATCH if max_batch is None else int(max_batch)
+        if len(qkeys) > mb or self.slot_exact is None:
             return None
         try:
             from dss_tpu import native as _native
@@ -823,13 +883,17 @@ class FastTable:
                         np.asarray(now, np.int64), (len(qkeys),)
                     )
                 ),
-                self.HOST_MAX_CANDIDATES,
+                self.HOST_MAX_CANDIDATES
+                if max_candidates is None
+                else int(max_candidates),
                 sample=cols[8], sample0=cols[9],
             )
             if res is None:
                 return None  # candidate gate: device path
             return res[0], res[1].astype(np.int64)
-        ranges = self.host_candidates(qkeys)
+        ranges = self.host_candidates(
+            qkeys, max_batch=mb, max_candidates=max_candidates
+        )
         if ranges is None:
             return None
         return self.query_host(

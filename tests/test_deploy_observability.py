@@ -178,6 +178,38 @@ def test_grafana_and_rules_cover_multihost():
     assert "dss_multihost_degraded" in alerts["DssMultihostDegraded"]
 
 
+def test_grafana_and_rules_cover_deadline_routing():
+    """The deadline router must stay observable: dashboard panels over
+    the route-mix counters + cost estimates, and a paging rule on
+    sustained deadline-shedding (the 504 fast-shed path)."""
+    dash = json.load(
+        open(os.path.join(ROOT, "deploy/grafana/dss-dashboard.json"))
+    )
+    exprs = [
+        t["expr"]
+        for p in dash["panels"]
+        for t in p.get("targets", [])
+    ]
+    for needed in (
+        "co_route_hostchunk_batches",
+        "co_route_device_batches",
+        "co_deadline_shed",
+        "co_est_device_floor_ms",
+        "co_est_host_chunk_ms",
+    ):
+        assert any(needed in e for e in exprs), needed
+    rules = yaml.safe_load(
+        open(os.path.join(ROOT, "deploy/prometheus/rules.yaml"))
+    )
+    alerts = {
+        r.get("alert"): r["expr"]
+        for g in rules["groups"]
+        for r in g["rules"]
+    }
+    assert "DssDeadlineShedding" in alerts
+    assert "co_deadline_shed" in alerts["DssDeadlineShedding"]
+
+
 def test_make_certs_provisions_trust_material(tmp_path):
     """deploy/make_certs.py (the reference's build/make-certs.py +
     apply-certs.sh analog): JWT keypair, region token, TLS CA chain,
