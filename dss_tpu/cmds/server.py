@@ -183,6 +183,17 @@ def make_parser() -> argparse.ArgumentParser:
         help="skip the background fused-kernel compile at startup",
     )
     p.add_argument(
+        "--no_resident",
+        action="store_true",
+        help="disable the resident serving kernel (ops/resident.py): "
+        "the persistent device-feeder loop with AOT-compiled shape "
+        "buckets and donated I/O that amortizes the device dispatch "
+        "floor across in-flight batches.  On by default for --storage "
+        "tpu; the deadline router then learns a separate resident "
+        "floor (DSS_CO_EST_RES_FLOOR_MS seed) and routes device-class "
+        "batches through the loop",
+    )
+    p.add_argument(
         "--workers",
         type=int,
         default=0,
@@ -403,6 +414,15 @@ def build(args) -> web.Application:
     rid = RIDService(store.rid, clock)
     scd = SCDService(store.scd, clock) if args.enable_scd else None
 
+    # resident serving kernel: on by default on the tpu backend — the
+    # coalescers grow the persistent device-feeder route and install
+    # fold-time AOT warm hooks; the bucket-grid boot warm runs on the
+    # warm thread below so the multi-second XLA compiles never race a
+    # request deadline
+    use_resident = args.storage == "tpu" and not args.no_resident
+    if use_resident:
+        store.configure_serving(resident=True)
+
     warm_thread = None
     if args.storage == "tpu" and not args.no_warmup:
         # compile the fused kernel's point-lookup executable in the
@@ -421,6 +441,17 @@ def build(args) -> web.Application:
                 )
             except Exception:  # noqa: BLE001 — warmup is best-effort
                 log.exception("fastpath warmup failed")
+            if use_resident:
+                try:
+                    t0 = time.perf_counter()
+                    n = store.warm_resident()
+                    log.info(
+                        "resident AOT warm: %d bucket executables "
+                        "in %.1fs",
+                        n, time.perf_counter() - t0,
+                    )
+                except Exception:  # noqa: BLE001 — best-effort
+                    log.exception("resident warm failed")
 
         warm_thread = threading.Thread(
             target=_warm, name="fastpath-warmup", daemon=True

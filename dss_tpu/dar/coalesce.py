@@ -60,6 +60,18 @@ Clockwork-style predictable-latency admission):
   floor); measured-cost routing is what moves the knee to the host's
   actual scan throughput.
 
+  RESIDENT ROUTE — when the resident serving kernel (ops/resident.py)
+  is attached, the device class splits in two: the cold fused dispatch
+  (one round trip per pack-stage submit) and the resident loop's
+  persistent device stream (AOT shape buckets, donated I/O, a feeder
+  that keeps several batches in flight so dispatch cost amortizes).
+  The router treats resident as a third candidate with ITS OWN
+  cost-model key (est_res_floor_ms, seeded by DSS_CO_EST_RES_FLOOR_MS)
+  fed only by resident observations — so the floor it learns is the
+  amortized resident floor, never polluted by (or polluting) the
+  cold-dispatch estimate.  A full resident ring falls back to the cold
+  path; the pack stage never blocks on the device stream.
+
 This replaces the reference's per-request SQL round trip to CRDB
 (goroutine-per-RPC, pkg/rid/cockroach/identification_service_area.go
 :166-197) with the TPU-idiomatic shape: request parallelism becomes
@@ -111,42 +123,85 @@ class _Item:
 
 
 class _CostModel:
-    """Online EWMA cost estimates for the two serving routes.
+    """Online EWMA cost estimates for the three serving routes.
 
-    Three scalars, seeded at boot (DSS_CO_EST_* knobs) and updated
+    Four scalars, seeded at boot (DSS_CO_EST_* knobs) and updated
     from every completed batch:
 
-      est_floor_ms — the device dispatch floor: what one fused-kernel
-          round trip costs before any per-query work (tunneled ~110 ms
-          in this dev environment, sub-ms on an attached TPU).
+      est_floor_ms — the COLD device dispatch floor: what one
+          fused-kernel round trip costs before any per-query work
+          (tunneled ~110 ms in this dev environment, sub-ms on an
+          attached TPU).
       est_item_ms  — marginal device cost per batched query on top of
           the floor (device batch time modeled as floor + item * n).
       est_chunk_ms — one warmed-bucket exact host scan
           (FastTable.query_host_chunked serves an n-item batch as
           ceil(n / chunk) of these).
+      est_res_floor_ms — the RESIDENT dispatch floor: the steady-state
+          marginal per-batch cost of the resident loop's device stream
+          (ops/resident.py — AOT buckets + donated I/O + pipelined
+          feeder).  Its OWN key on purpose: resident observations
+          never feed the cold floor and vice versa — with one shared
+          floor, whichever route runs more would drag the estimate
+          toward itself and poison routing for the other (a resident
+          steady state would make cold dispatches look free; one cold
+          dispatch would make the resident stream look floor-bound).
+      est_res_lat_ms — the resident stream's full per-batch LATENCY
+          (submit -> delivered), tracked separately from the floor:
+          pipelining amortizes *dispatch cost* but every batch still
+          rides one full round trip, so on a high-RTT host the stream
+          drains at floor rates while each batch takes ~RTT wall
+          clock.  Headroom (deadline) decisions use the latency;
+          throughput decisions (bulk routing, Retry-After, drain
+          pacing) use the floor.  Conflating them would route
+          fresh-SLO traffic into a stream it can never make deadlines
+          through.
 
-    The device pair is an exponentially-forgetting online least-squares
-    fit over observed (n, total_ms) pairs: the EWMA first/second
-    moments give slope = cov(n, t) / var(n) and floor = mean(t) -
-    slope * mean(n).  While every batch is the same size, var(n) ~ 0
-    and the seed slope stands with the floor absorbing the level (the
-    prediction AT observed sizes is exact, which is what the router
-    compares against headroom); mixed sizes disambiguate the split."""
+    The cold-device pair is an exponentially-forgetting online
+    least-squares fit over observed (n, total_ms) pairs: the EWMA
+    first/second moments give slope = cov(n, t) / var(n) and floor =
+    mean(t) - slope * mean(n).  While every batch is the same size,
+    var(n) ~ 0 and the seed slope stands with the floor absorbing the
+    level (the prediction AT observed sizes is exact, which is what
+    the router compares against headroom); mixed sizes disambiguate
+    the split.  The resident floor is a plain EWMA of the observed
+    level minus the (shared) per-item slope — the compute cost per
+    query is the same kernel either way; only the dispatch differs."""
 
     __slots__ = ("alpha", "chunk", "est_floor_ms", "est_item_ms",
-                 "est_chunk_ms", "device_obs", "host_obs",
+                 "est_chunk_ms", "est_res_floor_ms", "est_res_lat_ms",
+                 "device_obs", "host_obs", "resident_obs",
                  "_sn", "_st", "_snn", "_snt")
 
     def __init__(self, *, floor_ms: float = 20.0, item_ms: float = 0.02,
                  chunk_ms: float = 0.3, chunk: int = 64,
-                 alpha: float = 0.2):
+                 alpha: float = 0.2,
+                 res_floor_ms: Optional[float] = None,
+                 res_lat_ms: Optional[float] = None):
         self.alpha = float(alpha)
         self.chunk = max(1, int(chunk))
         self.est_floor_ms = float(floor_ms)
         self.est_item_ms = float(item_ms)
         self.est_chunk_ms = float(chunk_ms)
+        # default resident seed: the cold floor amortized over the
+        # loop's default in-flight window — deliberately conservative
+        # (a quarter, not a tenth) so the first resident batches must
+        # EARN a lower floor before the router leans on it
+        self.est_res_floor_ms = (
+            self.est_floor_ms / 4.0
+            if res_floor_ms is None
+            else float(res_floor_ms)
+        )
+        # latency seed: a batch entering an idle stream pays one full
+        # round trip — the cold floor is the honest prior, so
+        # high-RTT hosts don't bet fresh deadlines on the stream until
+        # it has MEASURED low latency
+        self.est_res_lat_ms = (
+            self.est_floor_ms if res_lat_ms is None else float(res_lat_ms)
+        )
         self.device_obs = 0
         self.host_obs = 0
+        self.resident_obs = 0
         # EWMA moments of (n, total_ms) for the device fit, primed
         # from the seed (at a representative batch size) so the first
         # observations BLEND into the seeded estimate instead of
@@ -195,11 +250,63 @@ class _CostModel:
         self.est_chunk_ms += self.alpha * (per - self.est_chunk_ms)
         self.host_obs += 1
 
+    def observe_resident(self, n: int, gap_ms: float,
+                         lat_ms: Optional[float] = None) -> None:
+        """Feed ONLY the resident keys: gap_ms is the loop's marginal
+        per-batch cost (inter-completion gap), so level = gap -
+        item * n is the amortized dispatch floor; lat_ms is the full
+        submit->delivered wall time feeding the latency EWMA the
+        deadline comparisons use.  Both winsorized like the cold fit —
+        one stall (GC pause, tunnel hiccup) must not route a steady
+        stream hostward."""
+        gap_ms = min(
+            float(gap_ms),
+            4.0 * max(self.predict_resident_ms(n), 0.05),
+        )
+        lvl = gap_ms - self.est_item_ms * float(max(1, n))
+        self.est_res_floor_ms = max(
+            0.02,
+            self.est_res_floor_ms
+            + self.alpha * (lvl - self.est_res_floor_ms),
+        )
+        if lat_ms is not None:
+            lat_ms = min(
+                float(lat_ms),
+                4.0 * max(self.predict_resident_latency_ms(n), 0.05),
+            )
+            lat_lvl = lat_ms - self.est_item_ms * float(max(1, n))
+            self.est_res_lat_ms = max(
+                0.02,
+                self.est_res_lat_ms
+                + self.alpha * (lat_lvl - self.est_res_lat_ms),
+            )
+        self.resident_obs += 1
+
     def predict_device_ms(self, n: int, inflight: int = 0) -> float:
         # batches already in the device stream must clear first; with
         # the double-buffered pipeline each adds ~a floor of wait
         return (
             self.est_floor_ms * (1 + max(0, int(inflight)))
+            + self.est_item_ms * n
+        )
+
+    def predict_resident_ms(self, n: int, inflight: int = 0) -> float:
+        # THROUGHPUT view: the resident stream pipelines, so each
+        # batch already queued at the loop adds ~one resident floor of
+        # wait, not a cold floor.  Use for bulk routing / drain pacing.
+        return (
+            self.est_res_floor_ms * (1 + max(0, int(inflight)))
+            + self.est_item_ms * n
+        )
+
+    def predict_resident_latency_ms(self, n: int,
+                                    inflight: int = 0) -> float:
+        # LATENCY view: one full stream round trip (pipelining never
+        # removes it) plus a floor of queue wait per batch ahead.  Use
+        # for headroom (deadline) comparisons.
+        return (
+            self.est_res_lat_ms
+            + self.est_res_floor_ms * max(0, int(inflight))
             + self.est_item_ms * n
         )
 
@@ -271,13 +378,15 @@ class _BatchController:
     def drain_cap(
         self, headroom_ms: Optional[float], cost: _CostModel,
         inflight: int, inflight_host_chunks: int = 0,
+        resident_ready: bool = False, inflight_resident: int = 0,
     ) -> int:
         """Deadline-aware drain bound: never drain more than the
         predicted route cost fits into the minimum queued headroom.
-        With rich headroom (the device route fits inside the budget)
+        With rich headroom (the device-class route — resident stream
+        when available, else cold dispatch — fits inside the budget)
         the AIMD size stands; under pressure — and only when the host
         route is the one that will actually be chosen (same
-        _HEADROOM_SAFETY budget as _choose_host_route, so the two
+        _HEADROOM_SAFETY budget as the route choice, so the two
         decisions cannot disagree) — the drain shrinks to the host
         chunks that fit, never below one warmed chunk (forward
         progress — a zero cap would starve the queue entirely)."""
@@ -285,6 +394,16 @@ class _BatchController:
             return self.cur
         budget_ms = _HEADROOM_SAFETY * max(0.0, headroom_ms)
         pred_dev = cost.predict_device_ms(self.cur, inflight)
+        if resident_ready:
+            # latency view, matching the route choice: a drain sized
+            # against the stream's throughput gap would admit batches
+            # the stream cannot deliver inside their deadlines
+            pred_dev = min(
+                pred_dev,
+                cost.predict_resident_latency_ms(
+                    self.cur, inflight_resident
+                ),
+            )
         if pred_dev <= budget_ms:
             return self.cur
         if (
@@ -333,6 +452,15 @@ def env_knobs() -> dict:
         ("DSS_CO_EST_FLOOR_MS", "est_floor_ms", float),
         ("DSS_CO_EST_ITEM_MS", "est_item_ms", float),
         ("DSS_CO_EST_CHUNK_MS", "est_chunk_ms", float),
+        # resident serving kernel (ops/resident.py): enable the
+        # persistent device-feeder loop, seed ITS OWN floor estimate
+        # (never shared with the cold-device floor), and size the
+        # host ring / device stream depth
+        ("DSS_CO_RESIDENT", "resident", _env_bool),
+        ("DSS_CO_EST_RES_FLOOR_MS", "est_res_floor_ms", float),
+        ("DSS_CO_EST_RES_LAT_MS", "est_res_lat_ms", float),
+        ("DSS_CO_RES_RING", "res_ring", int),
+        ("DSS_CO_RES_INFLIGHT", "res_inflight", int),
     ):
         raw = os.environ.get(env)
         if raw is not None:
@@ -377,6 +505,20 @@ class QueryCoalescer:
         est_floor_ms: float = 20.0,
         est_item_ms: float = 0.02,
         est_chunk_ms: float = 0.3,
+        resident: bool = False,  # enable the resident serving kernel
+        #   (ops/resident.py): a persistent device-feeder loop with
+        #   AOT shape buckets + donated I/O becomes a third route
+        #   candidate with its own cost-model key.  Servers on the tpu
+        #   backend enable it (cmds/server.py --no_resident opts out);
+        #   default off so host-only callers and tests keep the
+        #   two-route behavior unless they ask.
+        est_res_floor_ms: Optional[float] = None,  # resident floor
+        #   seed (DSS_CO_EST_RES_FLOOR_MS); None = est_floor_ms / 4
+        est_res_lat_ms: Optional[float] = None,  # resident stream
+        #   full-latency seed (DSS_CO_EST_RES_LAT_MS); None =
+        #   est_floor_ms — one round trip, the honest prior
+        res_ring: int = 32,  # resident host ring capacity (batches)
+        res_inflight: int = 4,  # resident device stream depth
         clock=time.monotonic,  # injectable for fake-clock routing tests
     ):
         self._table = table
@@ -414,7 +556,16 @@ class QueryCoalescer:
         self._cost = _CostModel(
             floor_ms=est_floor_ms, item_ms=est_item_ms,
             chunk_ms=est_chunk_ms, chunk=chunk,
+            res_floor_ms=est_res_floor_ms, res_lat_ms=est_res_lat_ms,
         )
+        # resident loop (created on demand — needs a table with the
+        # submit/collect split)
+        self._res_loop = None
+        self._res_ring = int(res_ring)
+        self._res_inflight = int(res_inflight)
+        self._inflight_resident = 0  # batches queued at the res loop
+        if resident:
+            self._make_resident_loop()
         self._inflight_q: _queue.Queue = _queue.Queue(
             maxsize=max(1, int(pipeline_depth))
         )
@@ -430,6 +581,7 @@ class QueryCoalescer:
         self._stat_route_host = 0  # batches fully served on the host
         self._stat_route_hostchunk = 0  # of those: forced chunked route
         self._stat_route_device = 0  # batches that touched the device
+        self._stat_route_resident = 0  # batches via the resident loop
         self._stat_pack_ms = 0.0
         self._stat_device_ms = 0.0
         self._stat_collect_ms = 0.0
@@ -443,6 +595,43 @@ class QueryCoalescer:
         self._mesh_max = 256  # beyond this, ONE local fused dispatch
         #                       beats serialized mesh chunk round trips
         self.mesh_offloads = 0
+
+    def _make_resident_loop(self):
+        """Create (once) the resident device-feeder loop and install
+        the fold-time AOT warm hook on the table.  Requires the
+        submit/collect split; silently stays off for plain tables."""
+        if self._res_loop is not None:
+            return
+        if getattr(self._table, "query_many_submit", None) is None:
+            return
+        from dss_tpu.ops.resident import ResidentLoop
+
+        self._res_loop = ResidentLoop(
+            self._table,
+            ring_capacity=self._res_ring,
+            max_inflight=self._res_inflight,
+        )
+        set_warm = getattr(self._table, "set_resident_warm", None)
+        if set_warm is not None:
+            kern = self._res_loop.kernel
+
+            def warm_hook(ft, _kern=kern):
+                # fold-time warm: only tables big enough to route to
+                # the device are worth AOT grid compiles — the tiny L1
+                # tiers a minor fold rebuilds serve from the host path
+                # anyway, and their block count changes every fold.
+                # ASYNC on purpose: a synchronous grid compile inside
+                # the fold would re-introduce the O(table) stall the
+                # tiered snapshots removed; until a bucket lands,
+                # submits ride the shared jit exactly as before.
+                if ft.n_postings >= 1 << 14:
+                    _kern.warm_async(ft)
+
+            set_warm(warm_hook)
+
+    def resident_loop(self):
+        """The attached ResidentLoop, or None (boot warm + tests)."""
+        return self._res_loop
 
     def set_mesh_delegate(self, fn, fresh_fn, min_batch: int = 64):
         """Route batches of >= min_batch bounded-staleness queries
@@ -464,9 +653,19 @@ class QueryCoalescer:
         admission_wait_s: Optional[float] = None,
         inline: Optional[bool] = None,
         slo_ms: Optional[float] = None,
+        resident: Optional[bool] = None,
     ) -> None:
         """Adjust serving knobs at runtime (ops endpoint / tests).
-        Pipeline depth is fixed at construction (the double buffer)."""
+        Pipeline depth is fixed at construction (the double buffer).
+        resident=True attaches the resident loop (idempotent);
+        resident=False detaches it for NEW batches (the loop drains
+        what it holds — in-flight callers still resolve)."""
+        if resident is not None:
+            if resident:
+                self._make_resident_loop()
+            elif self._res_loop is not None:
+                loop, self._res_loop = self._res_loop, None
+                loop.close(join=True)
         with self._cond:
             if slo_ms is not None:
                 self._slo_ms = float(slo_ms)
@@ -645,7 +844,10 @@ class QueryCoalescer:
         """Stop accepting queries and (by default) wait for BOTH stages
         to drain — queued and in-flight batches complete, and joining
         prevents the interpreter tearing down the device runtime while
-        a stage is mid-dispatch."""
+        a stage is mid-dispatch.  The resident loop is closed LAST
+        (after the pack stage can no longer enqueue into its ring):
+        it drains the ring, so batches still queued there at shutdown
+        are submitted, collected, and delivered like any other."""
         with self._cond:
             self._closed = True
             self._cond.notify_all()
@@ -657,6 +859,8 @@ class QueryCoalescer:
         for th in (pack_th, coll_th):
             if th is not None and th is not me:
                 th.join(timeout)
+        if self._res_loop is not None:
+            self._res_loop.close(join=True, timeout=timeout)
 
     # -- pipeline stages ------------------------------------------------------
 
@@ -690,6 +894,8 @@ class QueryCoalescer:
         cap = self._ctl.drain_cap(
             headroom_ms, self._cost, self._inflight_device,
             self._inflight_host_chunks,
+            resident_ready=self._resident_ready(),
+            inflight_resident=self._inflight_resident,
         )
         batch: List[_Item] = []
         expired: List[_Item] = []
@@ -706,28 +912,78 @@ class QueryCoalescer:
         del self._queue[:taken]
         return batch, expired, headroom_ms
 
-    def _choose_host_route(self, batch, headroom_ms) -> bool:
-        """The routing policy: serve this drain as chunked exact host
-        scans when the predicted device completion (dispatch floor +
-        per-size batch cost + queued device work) would blow the
-        tightest queued headroom budget (_HEADROOM_SAFETY of it — the
-        same budget drain_cap sizes against) AND the host chunks are
-        predicted to finish sooner.  Bulk/stale-ok/headroom-rich
-        batches keep the fused device kernel (headroom_ms is None for
-        those)."""
-        if headroom_ms is None:
-            return False
+    def _resident_ready(self) -> bool:
+        """Resident route admissible right now: loop attached and its
+        host ring has space (a full ring means the device stream is
+        already saturated — routing more at it would just queue)."""
+        return self._res_loop is not None and self._res_loop.has_space()
+
+    def _choose_route(self, batch, headroom_ms,
+                      allow_resident: bool = True) -> str:
+        """The routing policy, now over THREE candidates.
+
+        Bulk / all-stale drains (headroom_ms None) are throughput
+        decisions: ride the resident stream whenever it is attached,
+        has ring space, and its marginal (gap) cost beats a cold
+        dispatch — else the cold fused kernel.
+
+        Deadline-carrying drains are latency decisions: the
+        device-class candidate is whichever of resident/cold predicts
+        the lower COMPLETION LATENCY (for the stream that includes the
+        full round trip — est_res_lat_ms — pipelining amortizes
+        dispatch cost, never the wire).  If that latency blows the
+        headroom budget (_HEADROOM_SAFETY of it — the same budget
+        drain_cap sizes against) AND the host chunks are predicted to
+        finish sooner, the drain is served as chunked exact host scans
+        ("hostchunk")."""
+        n = len(batch)
         pred_dev = self._cost.predict_device_ms(
-            len(batch), self._inflight_device
+            n, self._inflight_device
         )
-        if pred_dev <= _HEADROOM_SAFETY * headroom_ms:
-            return False
-        return (
-            self._cost.predict_host_ms(
-                len(batch), self._inflight_host_chunks,
-                self._inflight_device,
+        res_ok = allow_resident and self._resident_ready()
+        if headroom_ms is None:
+            if res_ok and (
+                self._cost.predict_resident_ms(
+                    n, self._inflight_resident
+                )
+                < pred_dev
+            ):
+                return "resident"
+            return "device"
+        dc_lat, kind = pred_dev, "device"
+        if res_ok:
+            res_lat = self._cost.predict_resident_latency_ms(
+                n, self._inflight_resident
             )
-            < pred_dev
+            # tie-break toward the stream: at the seed state the
+            # latency keys are EQUAL (both one round trip), and a
+            # strict compare would starve the resident route of the
+            # very observations that lower its estimate — equal
+            # latency, strictly cheaper dispatch
+            if res_lat <= pred_dev:
+                dc_lat, kind = res_lat, "resident"
+        if dc_lat <= _HEADROOM_SAFETY * headroom_ms:
+            return kind
+        if (
+            self._cost.predict_host_ms(
+                n, self._inflight_host_chunks, self._inflight_device,
+            )
+            < dc_lat
+        ):
+            return "hostchunk"
+        return kind
+
+    def _choose_host_route(self, batch, headroom_ms) -> bool:
+        """Boolean view of _choose_route for consumers that CANNOT
+        ride the resident loop (the inline lone-caller path and the
+        mesh fallback run synchronously on the caller's thread).  The
+        resident candidate is excluded from the comparison: a batch
+        cleared only because the stream's latency fits would otherwise
+        be run as a COLD dispatch here and blow the very deadline the
+        clearance assumed."""
+        return (
+            self._choose_route(batch, headroom_ms, allow_resident=False)
+            == "hostchunk"
         )
 
     def _pack_loop(self):
@@ -778,9 +1034,20 @@ class QueryCoalescer:
                 if not self._mesh_eligible(batch):
                     submit = getattr(self._table, "query_many_submit", None)
                     if submit is not None:
-                        host_route = self._choose_host_route(
-                            batch, headroom_ms
-                        )
+                        route = self._choose_route(batch, headroom_ms)
+                        if route == "resident" and self._enqueue_resident(
+                            batch
+                        ):
+                            # the resident loop owns this batch now:
+                            # its feeder submits into the device
+                            # stream, its collector delivers + feeds
+                            # the resident cost key.  Nothing goes
+                            # through the collect stage.
+                            with self._cond:
+                                self._packing = False
+                                self._cond.notify_all()
+                            continue
+                        host_route = route == "hostchunk"
                         if host_route:
                             # forced chunked host scans execute on the
                             # COLLECT stage: running them here would
@@ -940,12 +1207,79 @@ class QueryCoalescer:
             it.result = res
             it.event.set()
 
+    def _enqueue_resident(self, batch: List[_Item]) -> bool:
+        """Hand a drained batch to the resident loop's host ring.
+        Non-blocking: False (ring full / loop closed) leaves the batch
+        with the caller, which falls back to the cold device path —
+        the pack stage never stalls behind the device stream.  The
+        loop's collector delivers results AND feeds the resident cost
+        key with the measured marginal (inter-completion) cost; the
+        cold-device floor is never touched by these observations."""
+        loop = self._res_loop
+        if loop is None:
+            return False
+        payload = self._pack_args(batch)
+
+        def done(results, err, gap_ms, lat_ms, used_device,
+                 _batch=batch):
+            if err is not None:
+                self._deliver_error(_batch, err)
+            else:
+                self._deliver_results(_batch, results)
+            with self._slock:
+                self._stat_batches += 1
+                self._stat_items += len(_batch)
+                self._stat_last_batch = len(_batch)
+                self._stat_route_resident += 1
+                if err is None:
+                    if used_device:
+                        # only batches that actually rode the device
+                        # stream feed the resident keys — a batch whose
+                        # tiers all answered host-side completes in
+                        # sub-ms and would train the stream estimates
+                        # toward host-scan cost, sending later
+                        # deadline traffic into a stream that cannot
+                        # deliver it (the cold path gates its models
+                        # on observed_device for the same reason)
+                        self._cost.observe_resident(
+                            len(_batch), gap_ms, lat_ms
+                        )
+                    elif len(_batch) >= self._cost.chunk:
+                        self._cost.observe_host(len(_batch), gap_ms)
+                if gap_ms > 0:
+                    inst = len(_batch) / (gap_ms / 1000.0)
+                    self._ema_qps = (
+                        inst if self._ema_qps == 0.0
+                        else 0.8 * self._ema_qps + 0.2 * inst
+                    )
+            with self._cond:
+                self._ctl.observe(len(_batch), gap_ms)
+                self._inflight -= 1
+                self._inflight_items -= len(_batch)
+                self._inflight_resident -= 1
+                self._cond.notify_all()
+
+        with self._cond:
+            self._inflight_resident += 1
+        if loop.enqueue(payload, done):
+            return True
+        with self._cond:
+            self._inflight_resident -= 1
+        return False
+
     @staticmethod
     def _pq_used_device(pq) -> bool:
         """Did this submitted batch touch the device?  (A forced host
         batch can still fall back per tier on candidate-cap overflow —
-        the router's accounting must see what actually happened.)"""
-        return pq is not None and any(
+        the router's accounting must see what actually happened.)
+        Delegates to _PendingQuery.used_device when available so the
+        predicate lives in one place (dar/snapshot.py)."""
+        if pq is None:
+            return False
+        fn = getattr(pq, "used_device", None)
+        if fn is not None:
+            return bool(fn())
+        return any(
             p is not None for p in getattr(pq, "tier_pending", ())
         )
 
@@ -1067,15 +1401,46 @@ class QueryCoalescer:
                 co_route_host_batches=self._stat_route_host,
                 co_route_hostchunk_batches=self._stat_route_hostchunk,
                 co_route_device_batches=self._stat_route_device,
+                co_route_resident_batches=self._stat_route_resident,
                 co_pack_ms_total=round(self._stat_pack_ms, 3),
                 co_device_ms_total=round(self._stat_device_ms, 3),
                 co_collect_ms_total=round(self._stat_collect_ms, 3),
                 co_last_batch=self._stat_last_batch,
                 co_ema_qps=round(self._ema_qps, 1),
-                # live cost-model estimates (the router's inputs)
+                # live cost-model estimates (the router's inputs);
+                # the resident floor is its OWN key — see _CostModel
                 co_est_device_floor_ms=round(self._cost.est_floor_ms, 4),
                 co_est_device_item_ms=round(self._cost.est_item_ms, 5),
                 co_est_host_chunk_ms=round(self._cost.est_chunk_ms, 4),
+                co_est_resident_floor_ms=round(
+                    self._cost.est_res_floor_ms, 4
+                ),
+                co_est_resident_lat_ms=round(
+                    self._cost.est_res_lat_ms, 4
+                ),
             )
+        # resident-loop gauges: stable key set whether or not the loop
+        # is attached (dashboards and the observability test expect
+        # the series to exist on every tpu-backend deployment)
+        if self._res_loop is not None:
+            rs = self._res_loop.stats()
+        else:
+            rs = {
+                "ring_depth": 0, "ring_cap": 0, "inflight": 0,
+                "enqueued": 0, "rejected": 0, "aot_hits": 0,
+                "aot_misses": 0, "aot_buckets": 0,
+                "aot_compile_ms_total": 0.0,
+            }
+        out.update(
+            co_res_ring_depth=rs["ring_depth"],
+            co_res_ring_cap=rs["ring_cap"],
+            co_res_inflight=rs["inflight"],
+            co_res_enqueued=rs["enqueued"],
+            co_res_rejected=rs["rejected"],
+            co_res_aot_hits=rs["aot_hits"],
+            co_res_aot_misses=rs["aot_misses"],
+            co_res_aot_buckets=rs["aot_buckets"],
+            co_res_aot_compile_ms_total=rs["aot_compile_ms_total"],
+        )
         out["mesh_offloads"] = self.mesh_offloads
         return out

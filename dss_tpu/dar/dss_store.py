@@ -962,8 +962,8 @@ class DSSStore:
         """Fan serving-pipeline knobs (QueryCoalescer.configure:
         min_batch / max_batch / target_batch_ms / queue_depth /
         admission_wait_s / inline / slo_ms — the per-query serving SLO
-        driving the deadline router) out to every entity class's
-        coalescer.  Boot-time defaults come from DSS_CO_* env vars
+        driving the deadline router — / resident, the persistent
+        device-feeder loop) out to every entity class's coalescer.  Boot-time defaults come from DSS_CO_* env vars
         (coalesce.env_knobs); this is the runtime override for ops
         tuning and tests.  No-op on the memory backend."""
         for index in (
@@ -973,6 +973,29 @@ class DSSStore:
             co = getattr(index, "coalescer", None)
             if co is not None:
                 co.configure(**knobs)
+
+    def warm_resident(self) -> int:
+        """AOT-compile the resident bucket grid for every entity
+        class's current tiers (ops/resident.py).  Call AFTER
+        configure_serving(resident=True) attached the loops; runs the
+        multi-second XLA compiles off the serving path (the server's
+        boot warm thread).  Returns executables built."""
+        n = 0
+        for index in (
+            self.rid._isa_index, self.rid._sub_index,
+            self.scd._op_index, self.scd._sub_index,
+        ):
+            co = getattr(index, "coalescer", None)
+            table = getattr(index, "table", None)
+            if co is None or table is None:
+                continue
+            loop = co.resident_loop()
+            if loop is None:
+                continue
+            warm = getattr(table, "warm_resident", None)
+            if warm is not None:
+                n += warm(loop.kernel)
+        return n
 
     def attach_mesh_replica(self, replica, min_batch: int = 64) -> None:
         """Route oversized bounded-staleness search batches from each

@@ -284,6 +284,14 @@ class _PendingQuery:
             if p is not None:
                 p.ready()
 
+    def used_device(self) -> bool:
+        """Did this batch touch the device?  (Any tier that could not
+        answer from its host postings copy submitted a kernel.)  The
+        ONE predicate the coalescer's pressure accounting and the
+        resident loop's cost attribution both consume — keep it here
+        so tier-accounting changes can't desync them."""
+        return any(p is not None for p in self.tier_pending)
+
 
 class DarTable:
     """HBM spatial index for one entity class: lock-free reads against
@@ -336,6 +344,12 @@ class DarTable:
         self._fold_thread: Optional[threading.Thread] = None
         self._last_write = 0.0
         self._closed = False
+        # resident-kernel warm hook (ops/resident.py): called with a
+        # freshly built snapshot's FastTable BEFORE it is swapped in,
+        # so a rebuild's new block count has its AOT bucket grid
+        # scheduled (async — compiles land on a background thread and
+        # must never stall the fold) as early as possible
+        self._resident_warm = None
         self._stats_folds = 0
         self._stats_fold_ms = 0.0
         self._stats_swap_ms = 0.0
@@ -527,6 +541,22 @@ class DarTable:
             gen0 = self._gen
         try:
             snap = self._build_snapshot(recs)  # pack + HBM upload, unlocked
+            if self._resident_warm is not None and snap.fast is not None:
+                try:
+                    # schedule the new snapshot's AOT shape buckets
+                    # (the hook is async — a grid compile must never
+                    # stall the fold; until a bucket lands, submits
+                    # fall back to the shared jit).  No-op when the
+                    # block count is unchanged — the process cache
+                    # already holds the grid, the minor-fold common
+                    # case.
+                    self._resident_warm(snap.fast)
+                except Exception:  # noqa: BLE001 — warm is best-effort
+                    import logging
+
+                    logging.getLogger("dss.dar").exception(
+                        "resident warm failed"
+                    )
             t_swap = time.perf_counter()
             with self._write_lock:
                 if self._gen != gen0:
@@ -621,6 +651,26 @@ class DarTable:
             self.records = {r.entity_id: r for r in records}
             self._rebuild_locked()
 
+    def set_resident_warm(self, fn) -> None:
+        """Install the fold-time resident warm hook: fn(fast_table) is
+        called with each freshly built snapshot's FastTable before the
+        swap (the QueryCoalescer installs this when its resident loop
+        is enabled)."""
+        self._resident_warm = fn
+
+    def warm_resident(self, kernel, batch_buckets=None,
+                      window_buckets=None) -> int:
+        """AOT-compile the resident bucket grid for every CURRENT tier
+        (server-boot warm; fold-time warm of future tiers goes through
+        set_resident_warm).  Returns fresh executables built."""
+        n = 0
+        for tier in self._state.tiers:
+            if tier.snap.fast is not None:
+                n += kernel.warm(
+                    tier.snap.fast, batch_buckets, window_buckets
+                )
+        return n
+
     # -- read path (lock-free) -----------------------------------------------
 
     def query(
@@ -663,6 +713,9 @@ class DarTable:
         owner_ids: Optional[np.ndarray] = None,  # i32[B], -1 = no filter
         state: Optional[_State] = None,  # pre-grabbed state (internal)
         host_route: bool = False,  # force chunked exact host scans
+        kernel=None,  # resident AOT selector (ops/resident.py): device
+        #               tiers run the pre-compiled donated executable
+        #               for their shape bucket instead of the shared jit
     ) -> Optional[_PendingQuery]:
         """The host/pack half of query_many: grab ONE immutable state,
         pack the query batch, and either answer small batches from the
@@ -726,7 +779,8 @@ class DarTable:
         tier_pending: List = [None] * len(st.tiers)
         for ti in need_device:
             tier_pending[ti] = st.tiers[ti].snap.fast.submit(
-                qkeys, alt_lo, alt_hi, t_start, t_end, now=now_arr
+                qkeys, alt_lo, alt_hi, t_start, t_end, now=now_arr,
+                kernel=kernel,
             )
         return _PendingQuery(
             st, b, qkeys, alt_lo, alt_hi, t_start, t_end, now_arr,
@@ -789,6 +843,7 @@ class DarTable:
         owner_ids: Optional[np.ndarray] = None,  # i32[B], -1 = no filter
         state: Optional[_State] = None,  # pre-grabbed state (internal)
         host_route: bool = False,  # force chunked exact host scans
+        kernel=None,  # resident AOT selector (ops/resident.py)
     ) -> List[List[str]]:
         """Batched search via the fused fast path + overlay scan.
         Lock-free: runs against ONE atomically-grabbed immutable state.
@@ -798,7 +853,7 @@ class DarTable:
             self.query_many_submit(
                 keys_list, alt_lo, alt_hi, t_start, t_end,
                 now=now, owner_ids=owner_ids, state=state,
-                host_route=host_route,
+                host_route=host_route, kernel=kernel,
             )
         )
 

@@ -11,8 +11,10 @@ Istio; the Go services expose nothing — SURVEY.md §5).  Exposes:
       counts/durations — DarTable.stats via the index stats)
   dss_dar_<class>_co_*                           serving-pipeline gauges
       (queue/batch/stage series plus the deadline router's route-mix
-      counters, co_deadline_shed, and the co_est_* live cost-model
-      estimates — QueryCoalescer.stats via the index stats)
+      counters — co_route_{host,hostchunk,device,resident}_batches —
+      co_deadline_shed, the co_est_* live cost-model estimates incl.
+      the resident floor, and the resident loop's co_res_* ring /
+      AOT-cache series — QueryCoalescer.stats via the index stats)
 
 Route labels are templatized (UUID path segments -> ":id") to bound
 cardinality.  Scrape at GET /metrics.

@@ -54,6 +54,7 @@ import jax.numpy as jnp
 INT32_MAX = np.int32(2**31 - 1)
 INT32_MIN = np.int32(-(2**31))
 BLOCK = 128  # postings per block == TPU lane width
+WORDS = BLOCK // 32  # u32 hit words per window
 
 _NATIVE: Optional[tuple] = None  # one-shot import cache (module|None,)
 
@@ -180,6 +181,102 @@ def _bitpack_weights() -> np.ndarray:
     return w
 
 
+def fused_window_filter(
+    b_alo, b_ahi, b_t0, b_t1,  # (NB, 128) exact block columns
+    wins,  # (2, NWpad) i32: [block index, start | end<<8 | qidx<<16]
+    q_alo, q_ahi,  # exact per-query f32[B]
+    q_t0, q_t1,  # exact per-query i64[B]; q_t0 pre-folded with now
+    #              host-side: t0_eff = max(t_start, now), so
+    #              `t_end >= t0_eff` covers both the window test and
+    #              the `ends at/after now` liveness rule, per query
+    *, max_words, chunk=16384,
+):
+    """Exact window filter + hit bit-packing + word compaction, all
+    on device — the fused kernel's pure function, at module level so
+    the resident subsystem (ops/resident.py) can AOT-compile its own
+    donated twin of the SAME tracing (bit-identical by construction).
+    FastTable._fused_xla is the shared non-donating jit of this.
+
+    Each window is one postings run's slice of one 128-block,
+    described by [start, end) lanes — no per-lane key compare (and no
+    key gather) needed.  Returns one flat i32 array:
+
+      out[0]                     = count of non-empty hit words
+      out[1 : 1+max_words]       = flat word positions (window*4+w)
+      out[1+max_words : ]        = u32 hit bits per word (as i32)
+
+    The D2H transfer is proportional to hit words, not windows
+    scanned.  Compaction is a hand-rolled cumsum+scatter (~35x
+    faster than jnp.nonzero's searchsorted lowering on TPU)."""
+    nw = wins.shape[1]
+    win_blk, meta = wins[0], wins[1]
+    win_q = meta >> 16
+    lanes = jnp.arange(BLOCK, dtype=jnp.int32)
+
+    def one_chunk(c):
+        blk, meta_c, alo_c, ahi_c, t0_c, t1_c = c
+        start = meta_c & 0xFF
+        end = (meta_c >> 8) & 0xFF
+        hit = (
+            (lanes[None, :] >= start[:, None])
+            & (lanes[None, :] < end[:, None])
+            & (jnp.take(b_ahi, blk, axis=0) >= alo_c[:, None])
+            & (jnp.take(b_alo, blk, axis=0) <= ahi_c[:, None])
+            & (jnp.take(b_t1, blk, axis=0) >= t0_c[:, None])
+            & (jnp.take(b_t0, blk, axis=0) <= t1_c[:, None])
+        )  # (C, 128) bool, exact
+        # bit-pack 128 lanes -> 4 u32 words (exact, incl. bit 31:
+        # disjoint bits, so modular i32 addition == bitwise OR)
+        h = hit.astype(jnp.int32).reshape(-1, WORDS, 32)
+        return jnp.sum(
+            h << jnp.arange(32, dtype=jnp.int32)[None, None, :],
+            axis=2,
+            dtype=jnp.int32,
+        )  # (C, 4) i32 bit patterns
+
+    cargs = (
+        win_blk,
+        meta,
+        jnp.take(q_alo, win_q),
+        jnp.take(q_ahi, win_q),
+        jnp.take(q_t0, win_q),
+        jnp.take(q_t1, win_q),
+    )
+    if nw <= chunk:
+        words = one_chunk(cargs)
+    else:
+        pad = (-nw) % chunk
+
+        def padq(a):
+            if pad:
+                a = jnp.concatenate([a, jnp.zeros(pad, a.dtype)])
+            return a.reshape(-1, chunk)
+
+        words = jax.lax.map(
+            one_chunk, tuple(padq(a) for a in cargs)
+        ).reshape(-1, WORDS)[:nw]
+
+    flat = words.ravel()  # (NW*4,) i32
+    nz = flat != 0
+    pos = jnp.cumsum(nz.astype(jnp.int32))
+    n_words = pos[-1]
+    # compact: scatter word index + bits into max_words slots
+    dst = jnp.where(nz, pos - 1, max_words)
+    wordpos = (
+        jnp.zeros((max_words + 1,), jnp.int32)
+        .at[dst]
+        .set(jnp.arange(flat.shape[0], dtype=jnp.int32), mode="drop")[
+            :max_words
+        ]
+    )
+    bits = (
+        jnp.zeros((max_words + 1,), jnp.int32)
+        .at[dst]
+        .set(flat, mode="drop")[:max_words]
+    )
+    return jnp.concatenate([n_words[None], wordpos, bits])
+
+
 def warmup(device=None) -> None:
     """Compile the fused kernel's small-burst executable ahead of
     traffic.  Point lookups (batch <= HOST_MAX_BATCH) answer from the
@@ -233,15 +330,20 @@ class PendingBatch:
     D2H copy (copy_to_host_async), so many batches can be in flight at
     once and the host sync per collect only waits for the stream."""
 
-    __slots__ = ("out", "win_q", "win_blk", "host_inputs", "nw", "max_words")
+    __slots__ = (
+        "out", "win_q", "win_blk", "host_inputs", "nw", "max_words",
+        "kernel",
+    )
 
-    def __init__(self, out, win_q, win_blk, host_inputs, nw, max_words):
+    def __init__(self, out, win_q, win_blk, host_inputs, nw, max_words,
+                 kernel=None):
         self.out = out  # device flat i32: [n_words, wordpos..., bits...]
         self.win_q = win_q
         self.win_blk = win_blk
         self.host_inputs = host_inputs  # for the overflow fallback
         self.nw = nw
         self.max_words = max_words
+        self.kernel = kernel  # resident AOT selector (overflow retry)
 
     def ready(self) -> None:
         """Block until the device computation has completed (readiness
@@ -413,100 +515,17 @@ class FastTable:
 
     # -- fused on-device kernel ----------------------------------------------
 
-    WORDS = BLOCK // 32  # u32 hit words per window
+    WORDS = WORDS  # u32 hit words per window (module constant, kept
+    #                as a class attr for back-compat)
 
-    @staticmethod
-    @partial(jax.jit, static_argnames=("max_words", "chunk"))
-    def _fused_xla(
-        b_alo, b_ahi, b_t0, b_t1,  # (NB, 128) exact block columns
-        wins,  # (2, NWpad) i32: [block index, start | end<<8 | qidx<<16]
-        q_alo, q_ahi,  # exact per-query f32[B]
-        q_t0, q_t1,  # exact per-query i64[B]; q_t0 pre-folded with now
-        #              host-side: t0_eff = max(t_start, now), so
-        #              `t_end >= t0_eff` covers both the window test and
-        #              the `ends at/after now` liveness rule, per query
-        *, max_words, chunk=16384,
-    ):
-        """Exact window filter + hit bit-packing + word compaction, all
-        on device.  Each window is one postings run's slice of one
-        128-block, described by [start, end) lanes — no per-lane key
-        compare (and no key gather) needed.  Returns one flat i32
-        array:
-
-          out[0]                     = count of non-empty hit words
-          out[1 : 1+max_words]       = flat word positions (window*4+w)
-          out[1+max_words : ]        = u32 hit bits per word (as i32)
-
-        The D2H transfer is proportional to hit words, not windows
-        scanned.  Compaction is a hand-rolled cumsum+scatter (~35x
-        faster than jnp.nonzero's searchsorted lowering on TPU)."""
-        nw = wins.shape[1]
-        win_blk, meta = wins[0], wins[1]
-        win_q = meta >> 16
-        lanes = jnp.arange(BLOCK, dtype=jnp.int32)
-
-        def one_chunk(c):
-            blk, meta_c, alo_c, ahi_c, t0_c, t1_c = c
-            start = meta_c & 0xFF
-            end = (meta_c >> 8) & 0xFF
-            hit = (
-                (lanes[None, :] >= start[:, None])
-                & (lanes[None, :] < end[:, None])
-                & (jnp.take(b_ahi, blk, axis=0) >= alo_c[:, None])
-                & (jnp.take(b_alo, blk, axis=0) <= ahi_c[:, None])
-                & (jnp.take(b_t1, blk, axis=0) >= t0_c[:, None])
-                & (jnp.take(b_t0, blk, axis=0) <= t1_c[:, None])
-            )  # (C, 128) bool, exact
-            # bit-pack 128 lanes -> 4 u32 words (exact, incl. bit 31:
-            # disjoint bits, so modular i32 addition == bitwise OR)
-            h = hit.astype(jnp.int32).reshape(-1, FastTable.WORDS, 32)
-            return jnp.sum(
-                h << jnp.arange(32, dtype=jnp.int32)[None, None, :],
-                axis=2,
-                dtype=jnp.int32,
-            )  # (C, 4) i32 bit patterns
-
-        cargs = (
-            win_blk,
-            meta,
-            jnp.take(q_alo, win_q),
-            jnp.take(q_ahi, win_q),
-            jnp.take(q_t0, win_q),
-            jnp.take(q_t1, win_q),
+    # the shared (non-donating) jit of the module-level fused kernel;
+    # the resident path compiles its own donated AOT twin of the same
+    # function (ops/resident.py) so both trace identically
+    _fused_xla = staticmethod(
+        partial(jax.jit, static_argnames=("max_words", "chunk"))(
+            fused_window_filter
         )
-        if nw <= chunk:
-            words = one_chunk(cargs)
-        else:
-            pad = (-nw) % chunk
-
-            def padq(a):
-                if pad:
-                    a = jnp.concatenate([a, jnp.zeros(pad, a.dtype)])
-                return a.reshape(-1, chunk)
-
-            words = jax.lax.map(
-                one_chunk, tuple(padq(a) for a in cargs)
-            ).reshape(-1, FastTable.WORDS)[:nw]
-
-        flat = words.ravel()  # (NW*4,) i32
-        nz = flat != 0
-        pos = jnp.cumsum(nz.astype(jnp.int32))
-        n_words = pos[-1]
-        # compact: scatter word index + bits into max_words slots
-        dst = jnp.where(nz, pos - 1, max_words)
-        wordpos = (
-            jnp.zeros((max_words + 1,), jnp.int32)
-            .at[dst]
-            .set(jnp.arange(flat.shape[0], dtype=jnp.int32), mode="drop")[
-                :max_words
-            ]
-        )
-        bits = (
-            jnp.zeros((max_words + 1,), jnp.int32)
-            .at[dst]
-            .set(flat, mode="drop")[:max_words]
-        )
-        return jnp.concatenate([n_words[None], wordpos, bits])
+    )
 
     # -- host window expansion (shared by legacy + fused paths) --------------
 
@@ -617,6 +636,10 @@ class FastTable:
         *,
         now,  # int scalar or i64[B] per-query request time
         max_words: Optional[int] = None,
+        kernel=None,  # resident AOT selector (ops/resident.py): maps
+        #               this submit's shape bucket to a pre-compiled
+        #               donated executable; None (or a miss) runs the
+        #               shared jit path
     ) -> Optional[PendingBatch]:
         """Enqueue one fused query batch (async; no device sync).
         Requires slot_exact.  Returns None when no query key has any
@@ -649,7 +672,7 @@ class FastTable:
             a = np.asarray(a, dtype)
             return np.concatenate([a, np.zeros(bpad, dtype)]) if bpad else a
 
-        out = self._fused_xla(
+        args = (
             self.b_alo,
             self.b_ahi,
             self.b_t0,
@@ -659,8 +682,21 @@ class FastTable:
             jnp.asarray(qpad(alt_hi, np.float32)),
             jnp.asarray(qpad(np.broadcast_to(t0_eff, (b,)), np.int64)),
             jnp.asarray(qpad(t_end, np.int64)),
-            max_words=max_words,
         )
+        # resident path: a pre-compiled (AOT, donated-I/O) executable
+        # for exactly this (blocks, window bucket, batch bucket,
+        # max_words) shape — no trace, no compile, no per-call output
+        # allocation in steady state.  A miss (unwarmed bucket) falls
+        # back to the shared jit, which is today's behavior.
+        fn = None
+        if kernel is not None:
+            fn = kernel.lookup(
+                self, wins.shape[1], b + bpad, max_words
+            )
+        if fn is not None:
+            out = fn(*args)
+        else:
+            out = self._fused_xla(*args, max_words=max_words)
         try:
             out.copy_to_host_async()
         except Exception:
@@ -672,6 +708,7 @@ class FastTable:
             (qkeys, alt_lo, alt_hi, t_start, t_end, now),
             nw,
             max_words,
+            kernel,
         )
 
     def collect(
@@ -694,7 +731,7 @@ class FastTable:
             return self.collect(
                 self.submit(
                     qkeys, alt_lo, alt_hi, t_start, t_end,
-                    now=now, max_words=hard,
+                    now=now, max_words=hard, kernel=pending.kernel,
                 )
             )
         wordpos = out[1 : 1 + n_words]

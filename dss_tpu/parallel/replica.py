@@ -305,6 +305,8 @@ class ShardedReplica:
         self._rebuilds = 0
         self._delta_refreshes = 0
         self._major_rebuilds = 0
+        self._warm_ms_total = 0.0  # publish-gating warm time (compile
+        #                            + layout commit per rebuild)
         self._last_fresh = 0.0  # monotonic time of last caught-up sync
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -544,8 +546,16 @@ class ShardedReplica:
         # warm the new dar's query executable BEFORE publishing: the
         # jit cache keys on the snapshot's postings-run capacity, so a
         # rebuild can mean a fresh XLA compile — readers keep hitting
-        # the old snapshot until the warmed one swaps in
+        # the old snapshot until the warmed one swaps in.  The warm
+        # also commits the query-input device layouts (put_global with
+        # the kernel's in_specs inside query_batch), so the first real
+        # offload after a swap pays neither a compile NOR a call-site
+        # resharding — the same publish-after-warm rule the resident
+        # kernel's fold hook follows (ops/resident.py).  Warm time is
+        # accounted (replica_warm_ms_total): it is the rebuild cost an
+        # operator trades for a stall-free first query.
         if built is not None:
+            t_warm = time.perf_counter()
             for wb in self.warm_batches:
                 try:
                     built.query_batch(
@@ -558,6 +568,7 @@ class ShardedReplica:
                     )
                 except Exception:  # noqa: BLE001 — warmup best-effort
                     pass
+            self._warm_ms_total += (time.perf_counter() - t_warm) * 1000
         with self._mu:
             self._snapshots[cls] = snap
             self._rebuilds += 1
@@ -809,6 +820,7 @@ class ShardedReplica:
             "replica_rebuilds": self._rebuilds,
             "replica_delta_refreshes": self._delta_refreshes,
             "replica_major_rebuilds": self._major_rebuilds,
+            "replica_warm_ms_total": round(self._warm_ms_total, 1),
             "replica_staleness_s": (
                 -1.0
                 if self._last_fresh == 0.0

@@ -367,14 +367,24 @@ class ShardedDar:
             )
             now_dev = mk(P("dp"), np.asarray(now_arr, np.int64))
         else:
+            # pre-partition the query inputs to the EXACT layout the
+            # compiled kernel consumes (the shard_map in_specs) — the
+            # pjit pitfall: an uncommitted jnp.asarray lands on the
+            # default device and XLA inserts a call-site resharding
+            # into every query, exactly what the resident-kernel work
+            # removes from the single-chip path (ops/resident.py).
+            # The postings/entity arrays were already put_global'd to
+            # their specs at build time; this closes the gap for the
+            # per-call side.
+            mk = partial(put_global, self.mesh)
             spec = QuerySpec(
-                keys=jnp.asarray(keys_batch, jnp.int32),
-                alt_lo=jnp.asarray(alt_lo, jnp.float32),
-                alt_hi=jnp.asarray(alt_hi, jnp.float32),
-                t_start=jnp.asarray(t_start, jnp.int64),
-                t_end=jnp.asarray(t_end, jnp.int64),
+                keys=mk(P("dp", None), np.asarray(keys_batch, np.int32)),
+                alt_lo=mk(P("dp"), np.asarray(alt_lo, np.float32)),
+                alt_hi=mk(P("dp"), np.asarray(alt_hi, np.float32)),
+                t_start=mk(P("dp"), np.asarray(t_start, np.int64)),
+                t_end=mk(P("dp"), np.asarray(t_end, np.int64)),
             )
-            now_dev = jnp.asarray(now_arr, jnp.int64)
+            now_dev = mk(P("dp"), np.asarray(now_arr, np.int64))
         slots, ovf = sharded_conflict_query_batch(
             self.post_key,
             self.post_ent,

@@ -210,6 +210,45 @@ def test_grafana_and_rules_cover_deadline_routing():
     assert "co_deadline_shed" in alerts["DssDeadlineShedding"]
 
 
+def test_grafana_and_rules_cover_resident_kernel():
+    """The resident serving kernel must stay observable: dashboard
+    panels over the route counter, ring depth/occupancy gauges, the
+    per-bucket AOT cache hit/miss counters, and the learned resident
+    floor — plus a paging rule on sustained ring-full rejections (the
+    cold-dispatch fallback burning the floor the loop exists to
+    amortize)."""
+    dash = json.load(
+        open(os.path.join(ROOT, "deploy/grafana/dss-dashboard.json"))
+    )
+    exprs = [
+        t["expr"]
+        for p in dash["panels"]
+        for t in p.get("targets", [])
+    ]
+    for needed in (
+        "co_route_resident_batches",
+        "co_res_ring_depth",
+        "co_res_ring_cap",
+        "co_res_inflight",
+        "co_res_rejected",
+        "co_res_aot_hits",
+        "co_res_aot_misses",
+        "co_res_aot_buckets",
+        "co_est_resident_floor_ms",
+    ):
+        assert any(needed in e for e in exprs), needed
+    rules = yaml.safe_load(
+        open(os.path.join(ROOT, "deploy/prometheus/rules.yaml"))
+    )
+    alerts = {
+        r.get("alert"): r["expr"]
+        for g in rules["groups"]
+        for r in g["rules"]
+    }
+    assert "DssResidentRingSaturated" in alerts
+    assert "co_res_rejected" in alerts["DssResidentRingSaturated"]
+
+
 def test_make_certs_provisions_trust_material(tmp_path):
     """deploy/make_certs.py (the reference's build/make-certs.py +
     apply-certs.sh analog): JWT keypair, region token, TLS CA chain,
