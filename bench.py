@@ -793,6 +793,307 @@ def workers_leg():
     )
 
 
+def _poll_store(n_isas: int, n_areas: int, cells_per_area: int,
+                storage: str):
+    """A DSSStore populated for the poll workload: `n_areas` disjoint
+    metro-area coverings, `n_isas` ISAs spread across them.  Returns
+    (store, areas, versions) where areas[i] is the uint64 covering of
+    area i and versions maps isa id -> current Version (for fenced
+    update writes)."""
+    from datetime import datetime, timedelta, timezone
+
+    from dss_tpu.dar.dss_store import DSSStore
+    from dss_tpu.geo.s2cell import dar_key_to_cell
+    from dss_tpu.models import rid as ridm
+
+    store = DSSStore(storage=storage)
+    t0 = datetime.now(timezone.utc) + timedelta(minutes=5)
+    t1 = t0 + timedelta(hours=24)
+    areas = [
+        dar_key_to_cell(
+            np.arange(
+                i * cells_per_area, (i + 1) * cells_per_area, dtype=np.int64
+            )
+        )
+        for i in range(n_areas)
+    ]
+    versions = {}
+    for k in range(n_isas):
+        area = areas[k % n_areas]
+        isa = ridm.IdentificationServiceArea(
+            id=str(__import__("uuid").UUID(int=k + 1, version=4)),
+            owner="bench",
+            url="https://uss.example/flights",
+            cells=area,
+            start_time=t0,
+            end_time=t1,
+            altitude_lo=0.0,
+            altitude_hi=3000.0,
+        )
+        stored = store.rid.insert_isa(isa)
+        versions[stored.id] = (stored.version, area)
+    # park the populated heap outside gen2 GC scans, as the server
+    # does after boot (cmds/server.py): the poll loop's p99 must
+    # measure the cache, not cyclic-GC pauses over the record heap
+    from dss_tpu.runtime import freeze_boot_heap
+
+    freeze_boot_heap()
+    return store, areas, (t0, t1), versions
+
+
+def _poll_pass(store, areas, window, versions, *, ratio, secs, threads,
+               zipf_a, seed=7):
+    """One closed-loop poll run against store.rid.search_isas: every
+    thread polls Zipf-favored areas and issues one fenced ISA update
+    per `ratio` polls (the writer side of the 100:1 model).  A serial
+    warm pass touches every area first (jit warm on the uncached run,
+    steady-state population on the cached one — the measured window is
+    the fleet's steady state, not 512 cold-start misses).  ->
+    (served_qps, p50_ms, p99_ms, polls, writes)."""
+    t0, _ = window
+    n_areas = len(areas)
+    for area in areas:
+        store.rid.search_isas(area, t0, None)
+    # Zipf-ranked area popularity, deterministic per seed
+    ranks = np.arange(1, n_areas + 1, dtype=np.float64)
+    probs = ranks ** (-zipf_a)
+    probs /= probs.sum()
+    stop = threading.Event()
+    lats: list = [[] for _ in range(threads)]
+    writes = [0] * threads
+    errs: list = []
+    ids = list(versions)
+
+    def client(i):
+        rng = np.random.default_rng(seed * 1000 + i)
+        pick = rng.choice(n_areas, size=4096, p=probs)
+        qi = 0
+        ops = 0
+        while not stop.is_set():
+            area = areas[int(pick[qi])]
+            qi = (qi + 1) % len(pick)
+            ops += 1
+            try:
+                if ratio > 0 and ops % (ratio + 1) == ratio:
+                    # fenced update of one ISA (same covering — the
+                    # write path that invalidates its area's entries)
+                    import dataclasses as _dc
+
+                    eid = ids[(i * 7919 + ops) % len(ids)]
+                    ver, a = versions[eid]
+                    upd = _dc.replace(
+                        store.rid.get_isa(eid), version=ver, cells=a
+                    )
+                    stored = store.rid.insert_isa(upd)
+                    if stored is not None:
+                        versions[eid] = (stored.version, a)
+                    writes[i] += 1
+                    continue
+                t_req = time.perf_counter()
+                store.rid.search_isas(area, t0, None)
+                lats[i].append(time.perf_counter() - t_req)
+            except Exception as e:  # noqa: BLE001 — fail the leg
+                errs.append(e)
+                return
+
+    ths = [threading.Thread(target=client, args=(i,)) for i in range(threads)]
+    t_run = time.perf_counter()
+    for t in ths:
+        t.start()
+    time.sleep(secs)
+    stop.set()
+    for t in ths:
+        t.join()
+    span = time.perf_counter() - t_run
+    if errs:
+        raise RuntimeError(f"poll leg hit errors: {errs[:3]}")
+    all_l = np.sort(np.concatenate([np.asarray(x) for x in lats]))
+    return {
+        "served_qps": round(len(all_l) / span, 1),
+        "p50_ms": round(float(all_l[len(all_l) // 2]) * 1000, 3),
+        "p99_ms": round(float(all_l[int(len(all_l) * 0.99)]) * 1000, 3),
+        "polls": int(len(all_l)),
+        "writes": int(sum(writes)),
+    }
+
+
+def poll_leg(emit: bool = True):
+    """Repeat-poll workload (`bench.py --leg poll`; also folded into
+    the default north-star output): DSS_BENCH_POLL_RATIO polls per
+    write (default 100:1) over Zipf-distributed metro areas, measured
+    twice through the REAL store search path — version-fenced cache ON
+    vs OFF on the same populated store — reporting served qps, hit
+    rate, and p99 for both.  The acceptance bar is >=10x served qps at
+    equal-or-better p99 with the cache on."""
+    ratio = int(os.environ.get("DSS_BENCH_POLL_RATIO", 100))
+    n_isas = int(os.environ.get("DSS_BENCH_POLL_ISAS", 4000))
+    n_areas = int(os.environ.get("DSS_BENCH_POLL_AREAS", 512))
+    cpa = int(os.environ.get("DSS_BENCH_POLL_CELLS", 64))
+    secs = float(os.environ.get("DSS_BENCH_POLL_SECS", 5.0))
+    # client threads scale with cores (same hygiene as the curve leg's
+    # offered-load scaling): on a 1-2 core host, 8 GIL-sharing client
+    # threads measure scheduler thrash, not the server's read path
+    threads = int(
+        os.environ.get(
+            "DSS_BENCH_POLL_THREADS",
+            min(8, max(4, 2 * (os.cpu_count() or 2))),
+        )
+    )
+    zipf_a = float(os.environ.get("DSS_BENCH_POLL_ZIPF", 1.1))
+    storage = os.environ.get("DSS_BENCH_POLL_STORAGE", "tpu")
+
+    passes = max(1, int(os.environ.get("DSS_BENCH_POLL_PASSES", 2)))
+    store, areas, window, versions = _poll_store(
+        n_isas, n_areas, cpa, storage
+    )
+    try:
+        # interleaved best-of-N passes per mode (same phase-noise
+        # normalization the headline leg uses): a shared/tunneled host
+        # can slow an entire pass 2-3x, and interleaving + best-of
+        # keeps one bad phase from landing entirely on one mode
+        base = cached = None
+        s0 = s1 = store.cache.stats()
+        for p in range(passes):
+            store.configure_serving(cache=False)
+            b = _poll_pass(
+                store, areas, window, versions, ratio=ratio, secs=secs,
+                threads=threads, zipf_a=zipf_a, seed=11 + 2 * p,
+            )
+            if base is None or b["served_qps"] > base["served_qps"]:
+                base = b
+            # cached pass: the version fence serves repeat polls;
+            # writes keep invalidating areas at the configured ratio
+            store.configure_serving(cache=True)
+            c0 = store.cache.stats()
+            c = _poll_pass(
+                store, areas, window, versions, ratio=ratio, secs=secs,
+                threads=threads, zipf_a=zipf_a, seed=12 + 2 * p,
+            )
+            if cached is None or c["served_qps"] > cached["served_qps"]:
+                cached = c
+                s0, s1 = c0, store.cache.stats()
+    finally:
+        store.close()
+    hits = s1["hits"] - s0["hits"]
+    misses = s1["misses"] - s0["misses"]
+    result = {
+        "poll_ratio": ratio,
+        "areas": n_areas,
+        "zipf_a": zipf_a,
+        "isas": n_isas,
+        "threads": threads,
+        "storage": storage,
+        "cached": cached,
+        "uncached": base,
+        "hit_rate": round(hits / max(1, hits + misses), 4),
+        "invalidations": s1["invalidations"] - s0["invalidations"],
+        "served_qps_speedup": round(
+            cached["served_qps"] / max(1e-9, base["served_qps"]), 2
+        ),
+        "p99_ratio": round(
+            cached["p99_ms"] / max(1e-9, base["p99_ms"]), 3
+        ),
+    }
+    if emit:
+        print(
+            json.dumps(
+                {
+                    "metric": "poll_served_qps_speedup",
+                    "value": result["served_qps_speedup"],
+                    "unit": "x",
+                    "detail": result,
+                }
+            )
+        )
+    return result
+
+
+def cache_smoke_leg():
+    """CI read-cache smoke (`bench.py --leg cache-smoke`): the
+    deterministic hit -> write-invalidate -> miss -> repopulate cycle
+    through the real store, asserting the acceptance contract — a hit
+    is bit-identical to the fresh path AND performs zero coalescer
+    enqueues and zero device dispatches (co_* counters frozen across
+    the hit).  Exits nonzero if the hit path goes unexercised."""
+    from datetime import timedelta
+
+    store, areas, window, versions = _poll_store(
+        n_isas=64, n_areas=8, cells_per_area=32,
+        storage=os.environ.get("DSS_BENCH_POLL_STORAGE", "tpu"),
+    )
+    t0, _ = window
+    try:
+        area = areas[0]
+
+        def co_counters():
+            st = store.stats()
+            return {
+                k: v
+                for k, v in st.items()
+                if k.endswith(("co_batches", "co_items", "co_inline"))
+            }
+
+        def ids_of(res):
+            return sorted(x.id for x in res)
+
+        # miss -> populate
+        fresh = ids_of(store.rid.search_isas(area, t0, None))
+        assert fresh, "poll area unexpectedly empty"
+        pre = co_counters()
+        pre_cache = store.cache.stats()
+        # hit: bit-identical, zero coalescer enqueues, zero dispatches
+        hit = ids_of(store.rid.search_isas(area, t0, None))
+        post = co_counters()
+        post_cache = store.cache.stats()
+        assert hit == fresh, f"cache hit diverged: {hit} != {fresh}"
+        assert post_cache["hits"] == pre_cache["hits"] + 1, (
+            pre_cache, post_cache,
+        )
+        assert post == pre, (
+            f"a cache hit touched the coalescer: {pre} -> {post}"
+        )
+        # write-invalidate: a fenced update in the polled area
+        import dataclasses as _dc
+
+        eid = next(i for i, (_, a) in versions.items() if a is areas[0])
+        ver, a = versions[eid]
+        upd = _dc.replace(store.rid.get_isa(eid), version=ver)
+        upd.end_time = upd.end_time + timedelta(hours=1)
+        assert store.rid.insert_isa(upd) is not None
+        # miss (fence rejected) -> fresh answer -> repopulate
+        c0 = store.cache.stats()
+        after = ids_of(store.rid.search_isas(area, t0, None))
+        c1 = store.cache.stats()
+        assert after == fresh, f"post-write answer diverged: {after}"
+        assert c1["invalidations"] == c0["invalidations"] + 1, (c0, c1)
+        assert c1["misses"] == c0["misses"] + 1, (c0, c1)
+        # repopulated: the next poll hits again
+        c2 = store.cache.stats()
+        again = ids_of(store.rid.search_isas(area, t0, None))
+        c3 = store.cache.stats()
+        assert again == after
+        assert c3["hits"] == c2["hits"] + 1, (c2, c3)
+        final = store.cache.stats()
+    finally:
+        store.close()
+    assert final["hits"] >= 2, f"hit path unexercised: {final}"
+    print(
+        json.dumps(
+            {
+                "metric": "read_cache_smoke",
+                "value": 1,
+                "unit": "ok",
+                "detail": {
+                    "hits": final["hits"],
+                    "misses": final["misses"],
+                    "invalidations": final["invalidations"],
+                    "entries": final["entries"],
+                },
+            }
+        )
+    )
+
+
 def curve_smoke_leg():
     """CI router smoke (`bench.py --leg curve-smoke`): a short
     DSS_BENCH_CURVE_QPS sweep on a small table, then two deterministic
@@ -1053,7 +1354,7 @@ def main():
     ap.add_argument(
         "--leg",
         choices=["north-star", "workers", "curve-smoke",
-                 "resident-smoke"],
+                 "resident-smoke", "poll", "cache-smoke"],
         default="north-star",
         help="'north-star': the headline SCD conflict-qps benchmark "
         "(default); 'workers': multi-worker HTTP serving scaling smoke "
@@ -1062,7 +1363,12 @@ def main():
         "the host-chunk and device routes; 'resident-smoke': boots "
         "the resident device-feeder loop, pushes a deterministic "
         "burst through it, and asserts clean shutdown with batches "
-        "still queued in the ring",
+        "still queued in the ring; 'poll': the repeat-poll workload "
+        "(DSS_BENCH_POLL_RATIO polls per write over Zipf areas) with "
+        "the version-fenced read cache on vs off; 'cache-smoke': "
+        "deterministic hit -> write-invalidate -> miss -> repopulate "
+        "CI cycle asserting a hit is bit-identical and performs zero "
+        "coalescer enqueues",
     )
     args = ap.parse_args()
     if args.leg == "workers":
@@ -1071,6 +1377,10 @@ def main():
         return curve_smoke_leg()
     if args.leg == "resident-smoke":
         return resident_smoke_leg()
+    if args.leg == "poll":
+        return poll_leg()
+    if args.leg == "cache-smoke":
+        return cache_smoke_leg()
 
     n_entities = int(os.environ.get("DSS_BENCH_ENTITIES", 1_000_000))
     n_cells = int(os.environ.get("DSS_BENCH_CELLS", 200_000))
@@ -1152,6 +1462,13 @@ def main():
             secs=float(os.environ.get("DSS_BENCH_CURVE_SECS", 3.0)),
         )
 
+    poll = None
+    if do_serving and os.environ.get("DSS_BENCH_POLL", "1") != "0":
+        # the repeat-poll leg (version-fenced read cache on vs off at
+        # a DSS_BENCH_POLL_RATIO read:write mix) rides the default run
+        # so the recorded BENCH JSON carries it
+        poll = poll_leg(emit=False)
+
     qps = h["qps"]
     result = {
         "metric": "scd_conflict_qps_1M_intents",
@@ -1190,6 +1507,9 @@ def main():
             # see dispatch_floor_ms)
             "qps_latency_curve": curve,
             "max_serving_qps_p50_under_5ms": max_ok,
+            # repeat-poll workload: the version-fenced read cache's
+            # served-qps/hit-rate/p99 claim at ~100:1 poll:write
+            "poll": poll,
             "backend": jax.devices()[0].platform,
             "device": str(jax.devices()[0]),
             "pipeline": "DarTable snapshot; fused: host-searchsorted +"

@@ -194,6 +194,7 @@ async def _call(fn, *args, request=None):
     installed on the worker thread so service code's covering/store/
     serialize timings land in the request's stage breakdown."""
     from dss_tpu.dar import deadline as _deadline
+    from dss_tpu.dar import readcache as _readcache
     from dss_tpu.obs import stages as _stages
 
     loop = asyncio.get_running_loop()
@@ -209,6 +210,14 @@ async def _call(fn, *args, request=None):
         try:
             return fn(*args)
         finally:
+            # the store's search path left its freshness note on THIS
+            # thread (readcache thread-local); hand it to the handler
+            # for the X-DSS-Freshness response header.  take_ always
+            # clears, so a pooled worker never leaks a note across
+            # requests.
+            note = _readcache.take_note()
+            if request is not None and note is not None:
+                request["dss_freshness"] = note
             if sink is not None:
                 _stages.set_sink(None)
             if route_dl is not None:
@@ -228,6 +237,24 @@ async def _call_r(request, fn, *args):
     return await _call(fn, *args, request=request)
 
 
+def _freshness_json_response(request, data) -> web.Response:
+    """json_response carrying the X-DSS-Freshness header when the
+    service call left a note: region epoch + DAR write generation +
+    cache hit/miss, so operators can verify the version fence from
+    the wire without reading code."""
+    note = request.get("dss_freshness")
+    headers = None
+    if note is not None:
+        headers = {
+            "X-DSS-Freshness": (
+                f"epoch={note['epoch'] or '-'};"
+                f"class={note['cls']};gen={note['gen']};"
+                f"cache={'hit' if note['hit'] else 'miss'}"
+            )
+        }
+    return web.json_response(data, headers=headers)
+
+
 # Routes a read-worker serves from its local WAL-tail replica; every
 # other route is proxied to the write leader.  Searches are the hot
 # path and inherently scan-like (bounded staleness = the follower poll
@@ -236,6 +263,7 @@ async def _call_r(request, fn, *args):
 WORKER_LOCAL_ROUTES = {
     ("GET", "/healthy"),
     ("GET", "/metrics"),
+    ("GET", "/status"),
     ("GET", "/aux/v1/validate_oauth"),
     ("GET", "/v1/dss/identification_service_areas"),
     ("GET", "/v1/dss/subscriptions"),
@@ -366,6 +394,7 @@ def build_app(
     metrics=None,
     dump_requests: bool = False,
     stats_fn=None,
+    status_fn=None,  # freshness introspection: DSSStore.freshness_status
     default_timeout_s: float = 10.0,
     replica=None,  # ShardedOpReplica: multi-chip read-replica surface
     trace_requests: bool = False,
@@ -412,6 +441,7 @@ def build_app(
             return await _call(fn, *args, request=request)
         from dss_tpu.dar import budget as _budget
         from dss_tpu.dar import deadline as _deadline
+        from dss_tpu.dar import readcache as _readcache
         from dss_tpu.obs import stages as _stages
 
         sink = request.get("dss_stages")
@@ -423,6 +453,10 @@ def build_app(
         if route_dl is not None:
             _deadline.set_route_deadline(route_dl)
         _budget.set_host_only(True)
+        # clear any stale freshness note on the loop thread: a prior
+        # request that escalated to the executor mid-note must not
+        # donate its note to this one (first-wins would keep it)
+        _readcache.take_note()
         try:
             return fn(*args)
         except _budget.NeedsDevice:
@@ -431,9 +465,17 @@ def build_app(
                 # timings — the executor re-run records the real ones
                 sink.clear()
                 sink.update(before)
+            # drop the aborted attempt's note BEFORE awaiting: the
+            # executor re-run stashes the real one, other inline
+            # requests may interleave during the await, and the
+            # finally below must find this thread's slot empty
+            _readcache.take_note()
             return await _call(fn, *args, request=request)
         finally:
             _budget.set_host_only(False)
+            note = _readcache.take_note()
+            if note is not None:
+                request["dss_freshness"] = note
             if sink is not None:
                 _stages.set_sink(None)
                 sink["service_ms"] = round(
@@ -466,6 +508,17 @@ def build_app(
         return web.Response(text="ok")
 
     app.router.add_get("/healthy", healthy)
+
+    async def status(request):
+        """Freshness introspection (no auth, like /healthy): region
+        epoch, per-class DAR write generation + cell-clock high-water
+        mark, and read-cache counters — the operator's view of the
+        version fence (docs/SERVING.md)."""
+        if status_fn is None:
+            return web.json_response({"ok": True})
+        return web.json_response(await _call_r(request, status_fn))
+
+    app.router.add_get("/status", status)
 
     if metrics is not None:
 
@@ -680,12 +733,13 @@ def build_app(
 
         async def isa_search(request):
             auth(request, _RID + "SearchIdentificationServiceAreas")
-            return web.json_response(
-                await _call_read(request, rid.search_isas, 
+            return _freshness_json_response(
+                request,
+                await _call_read(request, rid.search_isas,
                     request.query.get("area", ""),
                     request.query.get("earliest_time"),
                     request.query.get("latest_time"),
-                )
+                ),
             )
 
         async def sub_create(request):
@@ -725,8 +779,9 @@ def build_app(
 
         async def sub_search(request):
             owner = auth(request, _RID + "SearchSubscriptions")
-            return web.json_response(
-                await _call_read(request, rid.search_subscriptions, request.query.get("area", ""), owner)
+            return _freshness_json_response(
+                request,
+                await _call_read(request, rid.search_subscriptions, request.query.get("area", ""), owner),
             )
 
         base = "/v1/dss/identification_service_areas"
@@ -771,8 +826,9 @@ def build_app(
 
         async def op_query(request):
             owner = auth(request, _SCD + "SearchOperationReferences")
-            return web.json_response(
-                await _call_read(request, scd.search_operations, await _params(request), owner)
+            return _freshness_json_response(
+                request,
+                await _call_read(request, scd.search_operations, await _params(request), owner),
             )
 
         async def scd_sub_put(request):
@@ -803,8 +859,9 @@ def build_app(
 
         async def scd_sub_query(request):
             owner = auth(request, _SCD + "QuerySubscriptions")
-            return web.json_response(
-                await _call_read(request, scd.query_subscriptions, await _params(request), owner)
+            return _freshness_json_response(
+                request,
+                await _call_read(request, scd.query_subscriptions, await _params(request), owner),
             )
 
         async def constraint_put(request):

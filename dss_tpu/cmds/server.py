@@ -308,6 +308,7 @@ def build_worker(args) -> web.Application:
         metrics=metrics,
         dump_requests=args.dump_requests,
         stats_fn=stats_fn,
+        status_fn=store.freshness_status,
         default_timeout_s=args.default_timeout,
         trace_requests=args.trace_requests,
         inline_reads=_inline_reads(args),
@@ -410,6 +411,13 @@ def build(args) -> web.Application:
         args.wal_path or "(none)",
         args.enable_scd,
         args.region_url or "(standalone)",
+    )
+    log.info(
+        "read cache: %s (cap=%d entries, stale_lag=%d gens; "
+        "DSS_CACHE_* / configure_serving(cache=) to change)",
+        "enabled" if store.cache.enabled else "disabled",
+        store.cache.capacity,
+        store.cache.stale_lag,
     )
     rid = RIDService(store.rid, clock)
     scd = SCDService(store.scd, clock) if args.enable_scd else None
@@ -576,6 +584,7 @@ def build(args) -> web.Application:
         metrics=metrics,
         dump_requests=args.dump_requests,
         stats_fn=stats_fn,
+        status_fn=store.freshness_status,
         default_timeout_s=args.default_timeout,
         replica=replica,
         trace_requests=args.trace_requests,

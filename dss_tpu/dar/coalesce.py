@@ -98,7 +98,7 @@ from dss_tpu.ops.conflict import NO_TIME_HI, NO_TIME_LO
 class _Item:
     __slots__ = ("keys", "alt_lo", "alt_hi", "t_start", "t_end", "now",
                  "owner_id", "allow_stale", "deadline", "event", "result",
-                 "error")
+                 "error", "via_mesh")
 
     def __init__(self, keys, alt_lo, alt_hi, t_start, t_end, now, owner_id,
                  allow_stale=False, deadline=None):
@@ -117,6 +117,9 @@ class _Item:
         self.event = threading.Event()
         self.result: Optional[List[str]] = None
         self.error: Optional[BaseException] = None
+        # answered by the sharded mesh replica (bounded-stale): the
+        # read cache must not stamp this result as fresh
+        self.via_mesh = False
 
     def expired(self, now_monotonic: float) -> bool:
         return self.deadline is not None and self.deadline <= now_monotonic
@@ -587,6 +590,9 @@ class QueryCoalescer:
         self._stat_collect_ms = 0.0
         self._stat_last_batch = 0
         self._ema_qps = 0.0  # recent drain throughput, for Retry-After
+        # optional read-cache counter view (set_cache_view): per-class
+        # co_cache_* gauges merged into stats()
+        self._cache_view = None
         # optional multi-chip offload: big read-only batches can run on
         # a fresh ShardedReplica mesh instead of the local device
         self._mesh_fn = None
@@ -632,6 +638,15 @@ class QueryCoalescer:
     def resident_loop(self):
         """The attached ResidentLoop, or None (boot warm + tests)."""
         return self._res_loop
+
+    def set_cache_view(self, fn) -> None:
+        """Attach the read cache's per-class counter view (readcache
+        .ReadCache.class_stats): co_cache_{hits,misses,invalidations}
+        then ride this coalescer's stats into /metrics as
+        dss_dar_<class>_co_cache_* — hits ARE part of the serving
+        story (they bypass this pipeline entirely: no admission, no
+        deadline stamp, no Retry-After backlog contribution)."""
+        self._cache_view = fn
 
     def set_mesh_delegate(self, fn, fresh_fn, min_batch: int = 64):
         """Route batches of >= min_batch bounded-staleness queries
@@ -838,6 +853,12 @@ class QueryCoalescer:
             )
         if item.error is not None:
             raise item.error
+        if item.via_mesh:
+            # tell the store's cache layer (same thread) this answer
+            # is bounded-stale mesh output, not fresh-path output
+            from dss_tpu.dar import readcache as _readcache
+
+            _readcache.note_mesh_served()
         return item.result
 
     def close(self, join: bool = True, timeout: float = 30.0):
@@ -1324,6 +1345,7 @@ class QueryCoalescer:
                             keys, lo, hi, t0s, t1s, now
                         )
                         for it, res in zip(part, results):
+                            it.via_mesh = True  # before event.set()
                             it.result = res
                             it.event.set()
                     self.mesh_offloads += 1
@@ -1442,5 +1464,15 @@ class QueryCoalescer:
             co_res_aot_buckets=rs["aot_buckets"],
             co_res_aot_compile_ms_total=rs["aot_compile_ms_total"],
         )
+        # per-class read-cache counters (co_cache_*): stable key set so
+        # the /metrics series exist on every tpu-backend deployment
+        view = self._cache_view
+        if view is not None:
+            out.update(view())
+        else:
+            out.update(
+                co_cache_hits=0, co_cache_misses=0,
+                co_cache_invalidations=0,
+            )
         out["mesh_offloads"] = self.mesh_offloads
         return out

@@ -25,6 +25,7 @@ the checkpoint/resume story per SURVEY.md §5).
 from __future__ import annotations
 
 import contextlib
+import copy
 import dataclasses
 import threading
 from datetime import datetime, timedelta, timezone
@@ -35,15 +36,27 @@ import numpy as np
 from dss_tpu import errors
 from dss_tpu.clock import Clock, to_nanos
 from dss_tpu.dar import codec
+from dss_tpu.dar import readcache as rcache
 from dss_tpu.dar.index import MemorySpatialIndex, TpuSpatialIndex
 from dss_tpu.dar.store import RIDStore, SCDStore
 from dss_tpu.dar.wal import WriteAheadLog
+from dss_tpu.geo.covering import canonical_cells
 from dss_tpu.models import rid as ridm
 from dss_tpu.models import scd as scdm
 from dss_tpu.models.core import Version, new_ovn_from_time
 
 MAX_RID_SUBSCRIPTIONS_PER_AREA = 10  # DSS0030
 MAX_SCD_SUBSCRIPTIONS_PER_AREA = 10
+
+
+def _copy_rec(rec):
+    """Shallow defensive copy for search-result assembly: callers may
+    mutate the returned object (e.g. the SCD service blanks `ovn` for
+    non-owners) without touching the shared stored record.  Equivalent
+    to `dataclasses.replace(rec)` for these pure-data records but
+    ~1.5x cheaper — per-record assembly is the read path's largest
+    single cost at poll-heavy hit rates."""
+    return copy.copy(rec)
 
 
 def _lock_txn(lock):
@@ -105,6 +118,103 @@ class _TxnTimeMixin:
             yield self
 
 
+class _CachedSearchMixin:
+    """The version-fenced read-cache seam shared by both sub-stores.
+
+    `_cached_ids` fronts an index query_ids call: the covering is
+    canonicalized (sorted, deduped — the same form the pack path
+    assumes), the per-cell clock fence is read BEFORE the fresh query
+    runs, and a fenced hit returns in microseconds without ever
+    reaching the coalescer — no admission, no deadline stamp, no
+    Retry-After backlog contribution, no device.  Misses populate on
+    the way out (the coalescer's collect path has already resolved by
+    then) unless the answer came from the bounded-stale mesh replica,
+    which must never be stamped as fresh."""
+
+    _cache: Optional[rcache.ReadCache] = None
+    _epoch_fn = staticmethod(lambda: "")
+
+    def _init_cache(self, cache, epoch_fn):
+        self._cache = cache
+        if epoch_fn is not None:
+            self._epoch_fn = epoch_fn
+
+    def _fenced_index_swap(self, *old_indexes):
+        """Fresh indexes for a state reset, carrying the old cell
+        clocks with a bump_all() floor — THE mid-resync staleness
+        invariant, shared by both store classes so the ordering cannot
+        drift apart: flush the cache first (reclaims entries the floor
+        is about to orphan), build the replacement indexes BEFORE the
+        caller clears its dicts (factory cost stays outside the window
+        lock-free readers can observe), adopt each predecessor's clock
+        (O(1) — no stamp-array churn in the window), then floor it so
+        every fence stamped before the reset fails."""
+        if self._cache is not None:
+            self._cache.invalidate_all()
+        fresh = []
+        for ix in old_indexes:
+            clock = ix.cell_clock
+            new_ix = self._index_factory()
+            new_ix.adopt_cell_clock(clock)
+            clock.bump_all()
+            fresh.append(new_ix)
+        return fresh
+
+    def _cached_ids(
+        self,
+        cls: str,
+        index,
+        cells,  # canonical uint64 covering
+        qkey: tuple,  # class-specific window/alt key components
+        now_ns: int,  # the query's `now` (its only time-variant input)
+        allow_stale: bool,
+        run,  # () -> List[str], the fresh path (index.query_ids)
+        t_end_of,  # id -> t_end ns (from the record dict) or None
+        owner_id: Optional[int] = None,
+    ) -> List[str]:
+        cache = self._cache
+        clock_fence = getattr(index, "clock_fence", None)
+        if (
+            cache is None
+            or not cache.enabled
+            or clock_fence is None
+            # near-the-area-cap coverings: the O(|cells|) fence walk
+            # stops being "microseconds" — serve fresh rather than
+            # cache a key nobody repeats cheaply
+            or len(cells) > 16384
+        ):
+            return run()
+        epoch = self._epoch_fn()
+        fence = clock_fence(cells)
+        key = (cls, owner_id, qkey, cells.tobytes())
+        ids = cache.lookup(
+            cls, key, fence, epoch, int(now_ns), allow_stale
+        )
+        if ids is not None:
+            rcache.note_search(cls, epoch, fence[2], True)
+            return ids
+        rcache.take_mesh_served()  # clear any stale flag before running
+        ids = run()
+        if not rcache.take_mesh_served():
+            pairs_ids: List[str] = []
+            t1s: List[int] = []
+            for i in ids:
+                t1 = t_end_of(i)
+                if t1 is None:
+                    # record vanished between query and assembly: the
+                    # concurrent remove's clock bump will fence this
+                    # entry out; omitting the id matches what the
+                    # fresh path would return right now
+                    continue
+                pairs_ids.append(i)
+                t1s.append(t1)
+            cache.insert(
+                cls, key, fence, epoch, int(now_ns), pairs_ids, t1s
+            )
+        rcache.note_search(cls, epoch, fence[2], False)
+        return ids
+
+
 class TimestampOracle:
     """Strictly-increasing commit timestamps (microsecond granularity),
     the stand-in for CRDB's transaction_timestamp()."""
@@ -140,10 +250,10 @@ class OwnerInterner:
             return self._ids.setdefault(owner, len(self._ids))
 
 
-class RIDStoreImpl(_TxnTimeMixin, RIDStore):
+class RIDStoreImpl(_TxnTimeMixin, _CachedSearchMixin, RIDStore):
     def __init__(
         self, *, clock, ts_oracle, owners, lock, journal, index_factory,
-        txn=None, capture_undo=False,
+        txn=None, capture_undo=False, cache=None, epoch_fn=None,
     ):
         self._clock = clock
         self._ts = ts_oracle
@@ -157,17 +267,23 @@ class RIDStoreImpl(_TxnTimeMixin, RIDStore):
         # back an aborted txn precisely instead of resyncing from the log
         self._capture_undo = capture_undo
         self._init_txn_time()
+        self._init_cache(cache, epoch_fn)
         self._isas: Dict[str, ridm.IdentificationServiceArea] = {}
         self._subs: Dict[str, ridm.Subscription] = {}
         self._isa_index = index_factory()
         self._sub_index = index_factory()
 
     def reset_state(self):
-        """Drop all local state (region resync rebuilds from the log)."""
+        """Drop all local state (region resync rebuilds from the log);
+        _fenced_index_swap keeps the cache coherent and the readers'
+        mid-resync window as narrow as before the cache existed."""
+        new_isa, new_sub = self._fenced_index_swap(
+            self._isa_index, self._sub_index
+        )
         self._isas = {}
         self._subs = {}
-        self._isa_index = self._index_factory()
-        self._sub_index = self._index_factory()
+        self._isa_index = new_isa
+        self._sub_index = new_sub
 
     def serialize_state(self) -> dict:
         """Full-state snapshot as plain JSON docs (region snapshot
@@ -273,25 +389,38 @@ class RIDStoreImpl(_TxnTimeMixin, RIDStore):
         # lock-free read against the index's published snapshot;
         # allow_stale additionally permits a fresh mesh-replica answer
         # for oversized coalesced batches (service SEARCH paths only —
-        # transactional reads never set it)
+        # transactional reads never set it).  The version-fenced cache
+        # fronts the whole thing: a fenced hit never reaches the index.
         if len(np.asarray(cells).ravel()) == 0:
             raise errors.bad_request("missing cell IDs for query")
         if earliest is None:
             raise errors.internal("must call with an earliest start time.")
+        cells = canonical_cells(cells)
         e_ns = to_nanos(earliest)
-        ids = self._isa_index.query_ids(
-            cells,
-            t_start=e_ns,
-            t_end=None if latest is None else to_nanos(latest),
-            now=e_ns,
-            allow_stale=allow_stale,
+        l_ns = None if latest is None else to_nanos(latest)
+        ids = self._cached_ids(
+            "isa", self._isa_index, cells,
+            qkey=(e_ns, l_ns), now_ns=e_ns, allow_stale=allow_stale,
+            run=lambda: self._isa_index.query_ids(
+                cells, t_start=e_ns, t_end=l_ns, now=e_ns,
+                allow_stale=allow_stale,
+            ),
+            t_end_of=self._isa_t_end,
         )
         out = []
         for i in ids:
             isa = self._isas.get(i)
             if isa is not None:
-                out.append(dataclasses.replace(isa))
+                out.append(_copy_rec(isa))
         return out
+
+    def _isa_t_end(self, i) -> Optional[int]:
+        isa = self._isas.get(i)
+        return None if isa is None else to_nanos(isa.end_time)
+
+    def _rid_sub_t_end(self, i) -> Optional[int]:
+        sub = self._subs.get(i)
+        return None if sub is None else to_nanos(sub.end_time)
 
     # -- Subscriptions -------------------------------------------------------
 
@@ -359,25 +488,41 @@ class RIDStoreImpl(_TxnTimeMixin, RIDStore):
     def search_subscriptions(self, cells):
         if len(np.asarray(cells).ravel()) == 0:
             raise errors.bad_request("no location provided")
-        ids = self._sub_index.query_ids(cells, now=self._now_ns())
-        out = []
-        for i in ids:
-            sub = self._subs.get(i)
-            if sub is not None:
-                out.append(dataclasses.replace(sub))
-        return out
-
-    def search_subscriptions_by_owner(self, cells, owner):
-        if len(np.asarray(cells).ravel()) == 0:
-            raise errors.bad_request("no location provided")
-        ids = self._sub_index.query_ids(
-            cells, now=self._now_ns(), owner_id=self._owners.intern(owner)
+        cells = canonical_cells(cells)
+        now = self._now_ns()
+        ids = self._cached_ids(
+            "rid_sub", self._sub_index, cells,
+            qkey=(), now_ns=now, allow_stale=False,
+            run=lambda: self._sub_index.query_ids(cells, now=now),
+            t_end_of=self._rid_sub_t_end,
         )
         out = []
         for i in ids:
             sub = self._subs.get(i)
             if sub is not None:
-                out.append(dataclasses.replace(sub))
+                out.append(_copy_rec(sub))
+        return out
+
+    def search_subscriptions_by_owner(self, cells, owner):
+        if len(np.asarray(cells).ravel()) == 0:
+            raise errors.bad_request("no location provided")
+        cells = canonical_cells(cells)
+        now = self._now_ns()
+        oid = self._owners.intern(owner)
+        ids = self._cached_ids(
+            "rid_sub", self._sub_index, cells,
+            qkey=(), now_ns=now, allow_stale=False,
+            run=lambda: self._sub_index.query_ids(
+                cells, now=now, owner_id=oid
+            ),
+            t_end_of=self._rid_sub_t_end,
+            owner_id=oid,
+        )
+        out = []
+        for i in ids:
+            sub = self._subs.get(i)
+            if sub is not None:
+                out.append(_copy_rec(sub))
         return out
 
     def max_subscription_count_in_cells_by_owner(self, cells, owner):
@@ -430,7 +575,7 @@ class RIDStoreImpl(_TxnTimeMixin, RIDStore):
                 _bump_sub(self._subs, i)
 
 
-class SCDStoreImpl(_TxnTimeMixin, SCDStore):
+class SCDStoreImpl(_TxnTimeMixin, _CachedSearchMixin, SCDStore):
     def index_stats(self) -> dict:
         return self._op_index.stats()
 
@@ -439,7 +584,7 @@ class SCDStoreImpl(_TxnTimeMixin, SCDStore):
 
     def __init__(
         self, *, clock, ts_oracle, owners, lock, journal, index_factory,
-        txn=None, capture_undo=False,
+        txn=None, capture_undo=False, cache=None, epoch_fn=None,
     ):
         self._clock = clock
         self._ts = ts_oracle
@@ -450,17 +595,22 @@ class SCDStoreImpl(_TxnTimeMixin, SCDStore):
         self._index_factory = index_factory
         self._capture_undo = capture_undo
         self._init_txn_time()
+        self._init_cache(cache, epoch_fn)
         self._ops: Dict[str, scdm.Operation] = {}
         self._subs: Dict[str, scdm.Subscription] = {}
         self._op_index = index_factory()
         self._sub_index = index_factory()
 
     def reset_state(self):
-        """Drop all local state (region resync rebuilds from the log)."""
+        """Drop all local state (region resync rebuilds from the log);
+        _fenced_index_swap keeps the cache coherent — see RIDStoreImpl."""
+        new_op, new_sub = self._fenced_index_swap(
+            self._op_index, self._sub_index
+        )
         self._ops = {}
         self._subs = {}
-        self._op_index = self._index_factory()
-        self._sub_index = self._index_factory()
+        self._op_index = new_op
+        self._sub_index = new_sub
 
     def serialize_state(self) -> dict:
         """Full-state snapshot as plain JSON docs (region snapshot
@@ -535,17 +685,45 @@ class SCDStoreImpl(_TxnTimeMixin, SCDStore):
             self._owners.intern(sub.owner),
         )
 
+    def _op_t_end(self, i) -> Optional[int]:
+        op = self._ops.get(i)
+        return None if op is None else to_nanos(op.end_time)
+
+    def _scd_sub_t_end(self, i) -> Optional[int]:
+        sub = self._subs.get(i)
+        return None if sub is None else to_nanos(sub.end_time)
+
     def _search_ops(
         self, cells, alt_lo, alt_hi, earliest, latest, *, allow_stale=False
     ):
-        ids = self._op_index.query_ids(
-            cells,
-            alt_lo=alt_lo,
-            alt_hi=alt_hi,
-            t_start=None if earliest is None else to_nanos(earliest),
-            t_end=None if latest is None else to_nanos(latest),
-            now=self._now_ns(),
-            allow_stale=allow_stale,
+        # ONE cached integration point for every operation search:
+        # public SEARCH, OVN-conflict prechecks, dependent-operation
+        # resolution.  A fenced hit is bit-identical to the fresh path
+        # (the precheck runs under the pinned txn timestamp, which is
+        # exactly the `now` the cache re-filters at), so serving
+        # write-safety checks from it is sound.
+        cells = canonical_cells(cells)
+        t0_ns = None if earliest is None else to_nanos(earliest)
+        t1_ns = None if latest is None else to_nanos(latest)
+        now = self._now_ns()
+        ids = self._cached_ids(
+            "op", self._op_index, cells,
+            qkey=(
+                None if alt_lo is None else float(alt_lo),
+                None if alt_hi is None else float(alt_hi),
+                t0_ns, t1_ns,
+            ),
+            now_ns=now, allow_stale=allow_stale,
+            run=lambda: self._op_index.query_ids(
+                cells,
+                alt_lo=alt_lo,
+                alt_hi=alt_hi,
+                t_start=t0_ns,
+                t_end=t1_ns,
+                now=now,
+                allow_stale=allow_stale,
+            ),
+            t_end_of=self._op_t_end,
         )
         # .get(): a concurrent delete between the index query and this
         # assembly must skip, not KeyError (reads are lock-free)
@@ -553,7 +731,7 @@ class SCDStoreImpl(_TxnTimeMixin, SCDStore):
         for i in sorted(ids):
             op = self._ops.get(i)
             if op is not None:
-                out.append(dataclasses.replace(op))
+                out.append(_copy_rec(op))
         return out
 
     def search_operations(
@@ -801,15 +979,26 @@ class SCDStoreImpl(_TxnTimeMixin, SCDStore):
         """
         if len(np.asarray(cells).ravel()) == 0:
             raise errors.bad_request("no location provided")
-        ids = self._sub_index.query_ids(
-            cells, now=self._now_ns(), owner_id=self._owners.intern(owner)
+        cells = canonical_cells(cells)
+        now = self._now_ns()
+        oid = self._owners.intern(owner)
+        ids = self._cached_ids(
+            "scd_sub", self._sub_index, cells,
+            qkey=(), now_ns=now, allow_stale=False,
+            run=lambda: self._sub_index.query_ids(
+                cells, now=now, owner_id=oid
+            ),
+            t_end_of=self._scd_sub_t_end,
+            owner_id=oid,
         )
         out = []
         for i in sorted(ids):
             sub = self._subs.get(i)
             if sub is None:
                 continue
-            s = dataclasses.replace(sub)
+            s = _copy_rec(sub)
+            # dependent ops resolve fresh each time (and their inner
+            # _search_ops calls ride the cache themselves)
             s.dependent_operations = self._dependent_ops(sub)
             out.append(s)
         return out
@@ -885,6 +1074,7 @@ class DSSStore:
         self._lock = threading.RLock()
         self.region = None
         txn = None
+        epoch_fn = None
         if region_url:
             from dss_tpu.region.client import RegionClient
             from dss_tpu.region.coordinator import RegionCoordinator
@@ -893,6 +1083,13 @@ class DSSStore:
                 region_url, instance_id, auth_token=region_token
             )
             txn = self._region_txn
+            # region epoch joins the cache fence: a promotion or a
+            # restored-backup rotation invalidates every cached answer
+            epoch_fn = self._region_client.current_epoch
+        # version-fenced read cache (dar/readcache.py): one shared
+        # instance fronting all four entity classes' search paths;
+        # DSS_CACHE_* env knobs, configure_serving(cache=) at runtime
+        self.cache = rcache.ReadCache(**rcache.env_knobs())
         ts = TimestampOracle(self.clock)
         owners = OwnerInterner()
         self.rid = RIDStoreImpl(
@@ -904,6 +1101,8 @@ class DSSStore:
             index_factory=index_factory,
             txn=txn,
             capture_undo=bool(region_url),
+            cache=self.cache,
+            epoch_fn=epoch_fn,
         )
         self.scd = SCDStoreImpl(
             clock=self.clock,
@@ -914,7 +1113,23 @@ class DSSStore:
             index_factory=index_factory,
             txn=txn,
             capture_undo=bool(region_url),
+            cache=self.cache,
+            epoch_fn=epoch_fn,
         )
+        # per-class cache hit/miss counters ride the coalescer stats
+        # path (dss_dar_<class>_co_cache_* in /metrics), so hit rate
+        # renders next to the route mix it removes load from
+        for index, cls in (
+            (self.rid._isa_index, "isa"),
+            (self.rid._sub_index, "rid_sub"),
+            (self.scd._op_index, "op"),
+            (self.scd._sub_index, "scd_sub"),
+        ):
+            co = getattr(index, "coalescer", None)
+            if co is not None:
+                co.set_cache_view(
+                    lambda cls=cls: self.cache.class_stats(cls)
+                )
         self._replaying = False
         if region_url:
             self.region = RegionCoordinator(
@@ -965,7 +1180,14 @@ class DSSStore:
         driving the deadline router — / resident, the persistent
         device-feeder loop) out to every entity class's coalescer.  Boot-time defaults come from DSS_CO_* env vars
         (coalesce.env_knobs); this is the runtime override for ops
-        tuning and tests.  No-op on the memory backend."""
+        tuning and tests.  No-op on the memory backend — except
+        `cache`, the version-fenced read cache toggle, which applies
+        on both backends (disable flushes; see OPERATIONS.md runbook)."""
+        cache = knobs.pop("cache", None)
+        if cache is not None:
+            self.cache.configure(enabled=bool(cache))
+        if not knobs:
+            return
         for index in (
             self.rid._isa_index, self.rid._sub_index,
             self.scd._op_index, self.scd._sub_index,
@@ -1049,6 +1271,40 @@ class DSSStore:
         ):
             for k, v in stats().items():
                 out[f"dss_dar_{name}_{k}"] = v
+        # store-wide read-cache gauges (stable key set whether the
+        # cache is enabled or not — dashboards expect the series)
+        for k, v in self.cache.stats().items():
+            out[f"dss_cache_{k}"] = v
         if self.region is not None:
             out.update(self.region.stats())
         return out
+
+    def freshness_status(self) -> dict:
+        """Operator view of the version-fence state (GET /status):
+        region epoch, per-class write generation + cell-clock
+        high-water mark, and the cache counters — enough to verify
+        fence behaviour without reading code."""
+        classes = {}
+        for name, index in (
+            ("isa", self.rid._isa_index),
+            ("rid_sub", self.rid._sub_index),
+            ("op", self.scd._op_index),
+            ("scd_sub", self.scd._sub_index),
+        ):
+            clock = getattr(index, "cell_clock", None)
+            classes[name] = {
+                "generation": 0 if clock is None else clock.generation,
+                "cell_clock_high_water": (
+                    0 if clock is None else clock.high_water
+                ),
+                "live_records": index.stats().get("live_records", 0),
+            }
+        epoch = ""
+        if self.region is not None:
+            epoch = self._region_client.current_epoch()
+        return {
+            "storage": self.storage,
+            "epoch": epoch,
+            "cache": self.cache.stats(),
+            "classes": classes,
+        }

@@ -17,6 +17,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from dss_tpu.dar import oracle
+from dss_tpu.dar import tiers as tiersmod
 from dss_tpu.dar.coalesce import QueryCoalescer
 from dss_tpu.dar.coalesce import env_knobs as coalesce_env_knobs
 from dss_tpu.dar.oracle import Record
@@ -31,9 +32,14 @@ def _to_keys(cells_u64: np.ndarray) -> np.ndarray:
 class MemorySpatialIndex:
     def __init__(self):
         self._recs: Dict[str, Record] = {}
+        # same per-cell write clock as the DarTable backend, so the
+        # version-fenced read cache (dar/readcache.py) is exact on
+        # both storage strategies
+        self.cell_clock = tiersmod.CellClock()
 
     def put(self, id, cells_u64, alt_lo, alt_hi, t_start, t_end, owner_id):
         keys = np.unique(_to_keys(cells_u64))
+        old = self._recs.get(id)
         self._recs[id] = Record(
             entity_id=id,
             keys=keys,
@@ -43,9 +49,26 @@ class MemorySpatialIndex:
             t_end=int(t_end),
             owner_id=int(owner_id),
         )
+        # bump after the mutation (fail-closed for lock-free readers);
+        # old + new coverings both change their cells' answers
+        self.cell_clock.bump(None if old is None else old.keys, keys)
 
     def remove(self, id):
-        self._recs.pop(id, None)
+        old = self._recs.pop(id, None)
+        if old is not None:
+            self.cell_clock.bump(old.keys)
+
+    def clock_fence(self, cells_u64) -> "tuple[int, int, int, int]":
+        """(incarnation, max stamp, generation, floor) over the
+        covering — the read cache's O(|cells|) validity check."""
+        return self.cell_clock.fence(_to_keys(cells_u64))
+
+    def adopt_cell_clock(self, clock: tiersmod.CellClock) -> None:
+        """Carry a predecessor index's clock across a state reset
+        (region resync): the caller bump_all()s it, which floors every
+        older fence — O(1), no stamp-array reallocation inside the
+        resync swap window lock-free readers can observe."""
+        self.cell_clock = clock
 
     def query_ids(
         self,
@@ -72,7 +95,11 @@ class MemorySpatialIndex:
         return oracle.max_count_per_cell(recs, keys, owner_id, now)
 
     def stats(self) -> dict:
-        return {"live_records": len(self._recs)}
+        return {
+            "live_records": len(self._recs),
+            "write_generation": self.cell_clock.generation,
+            "cell_clock_high_water": self.cell_clock.high_water,
+        }
 
 
 class TpuSpatialIndex:
@@ -121,6 +148,19 @@ class TpuSpatialIndex:
         return self._table.max_owner_count(
             _to_keys(cells_u64), owner_id, now=int(now)
         )
+
+    @property
+    def cell_clock(self) -> tiersmod.CellClock:
+        return self._table.cell_clock
+
+    def clock_fence(self, cells_u64) -> "tuple[int, int, int, int]":
+        """(incarnation, max stamp, generation, floor) over the
+        covering — the read cache's O(|cells|) validity check."""
+        return self._table.cell_clock.fence(_to_keys(cells_u64))
+
+    def adopt_cell_clock(self, clock: tiersmod.CellClock) -> None:
+        """See MemorySpatialIndex.adopt_cell_clock."""
+        self._table.cell_clock = clock
 
     def stats(self) -> dict:
         out = self._table.stats()

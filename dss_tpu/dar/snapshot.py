@@ -325,6 +325,13 @@ class DarTable:
         )
         self._write_lock = threading.RLock()
         self._rebuild_postings = delta_capacity
+        # per-cell write clock (tiers.CellClock): every upsert/remove
+        # stamps the affected DAR keys AFTER the state publish, so a
+        # version-fenced cache entry stamped before a write can never
+        # survive it (dar/readcache.py).  Lives on the table, not in
+        # the published state — minor folds and major compactions swap
+        # snapshots without ever touching the stamps.
+        self.cell_clock = tiersmod.CellClock()
         self.records: Dict[str, Record] = {}  # authoritative, writer-owned
         self._state: _State = _EMPTY_STATE
         # writer-owned overlay index (id -> local idx in the overlay);
@@ -382,6 +389,7 @@ class DarTable:
             owner_id=int(owner_id),
         )
         with self._write_lock:
+            old = self.records.get(entity_id)
             self.records[entity_id] = rec
             self._delta[entity_id] = rec
             st = self._state
@@ -395,6 +403,15 @@ class DarTable:
             self._overlay_idx[entity_id] = idx
             # one atomic publish: tiers + overlay + dead sets together
             self._state = _State(tiers, pending, overlay)
+            # clock bump LAST (after the publish): a concurrent
+            # lock-free cache miss that read its fence before this
+            # write can only produce an entry stamped too OLD, which
+            # the next fence check discards — never one stamped fresh
+            # over pre-write data.  Old + new coverings both bump: a
+            # record leaving cell X changes X's answers too.
+            self.cell_clock.bump(
+                None if old is None else old.keys, keys
+            )
             self._last_write = time.monotonic()
             if len(overlay.key) > self._rebuild_postings:
                 self._request_fold()
@@ -420,6 +437,7 @@ class DarTable:
             if self._folding:
                 self._fold_removed.append(entity_id)
             self._state = _State(tiers, pending, overlay)
+            self.cell_clock.bump(rec.keys)  # after publish, like upsert
             self._last_write = time.monotonic()
             return True
 
@@ -650,6 +668,9 @@ class DarTable:
         with self._write_lock:
             self.records = {r.entity_id: r for r in records}
             self._rebuild_locked()
+            # wholesale replacement: raise the clock floor (O(1))
+            # instead of stamping every record's covering
+            self.cell_clock.bump_all()
 
     def set_resident_warm(self, fn) -> None:
         """Install the fold-time resident warm hook: fn(fast_table) is
@@ -917,6 +938,11 @@ class DarTable:
             "tier_compactions": self._stats_compactions,
             "tier_compact_ms_total": round(self._stats_compact_ms, 1),
             "tier_ratio": self._tier_ratio,
+            # version-fence introspection (/status + /metrics): the
+            # write generation and the cell-clock high-water mark the
+            # read cache fences against
+            "write_generation": self.cell_clock.generation,
+            "cell_clock_high_water": self.cell_clock.high_water,
         }
         out.update(tier)
         return out

@@ -47,7 +47,9 @@ Knobs (env, read at DarTable construction; docs/OPERATIONS.md):
 
 from __future__ import annotations
 
+import itertools
 import os
+import threading
 from typing import Dict, List, NamedTuple, Optional, Tuple
 
 import numpy as np
@@ -55,6 +57,123 @@ import numpy as np
 from dss_tpu.dar.oracle import Record
 from dss_tpu.dar.pack import pack_records
 from dss_tpu.ops.fastpath import FastTable
+
+# CellClock incarnations are process-unique: a rebuilt/replaced index
+# (region resync, restore_state) gets a fresh clock whose stamps start
+# over, and the read cache must never compare stamps across clocks —
+# the incarnation in the fence makes cross-clock comparison impossible
+# (id() reuse after GC would not).
+_INCARNATIONS = itertools.count(1)
+
+
+class CellClock:
+    """Per-cell monotonic write clock — the exact-invalidation currency
+    of the version-fenced read cache (dar/readcache.py).
+
+    One global counter per clock; every write bumps it once (the
+    `generation`) and stamps that value onto each affected DAR key's
+    slot.  Because the counter is shared, `max over a covering's
+    cells` is a sufficient fence: any later write touching ANY of
+    those cells stamps a value strictly greater than every earlier
+    max — so a cached entry needs only the scalar max, not the
+    per-cell vector.
+
+    Stamps live in a FIXED hashed-slot int64 array (default 2^20
+    slots, 8 MB), not a dict: a 10M-entity table touching millions of
+    distinct cells must not grow clock bookkeeping without bound, and
+    the bump under the write lock becomes one vectorized scatter
+    instead of a Python per-key loop.  Two cells sharing a slot can
+    only OVER-invalidate (a fence sees a too-new stamp and the cache
+    re-runs the query) — collisions are a hit-rate tax, never a
+    staleness bug.
+
+    Stamps survive minor folds and major compactions by construction:
+    the clock lives on the writer (DarTable / MemorySpatialIndex), not
+    in the published snapshot state, so fold/compaction swaps never
+    touch it.  Wholesale replacements (bulk_load) bump the `floor`
+    instead of walking every record — every fence computed afterwards
+    is at least the floor, which invalidates all earlier entries in
+    O(1).
+
+    Writers bump under their own write lock; `fence` is lock-free (a
+    racing scatter shows each slot either the old or the new stamp —
+    a newer value fails the fence, which is the safe direction)."""
+
+    __slots__ = ("_clock", "_mask", "_gen", "_high", "_floor",
+                 "incarnation", "_lock")
+
+    SLOTS = 1 << 20  # per-class stamp array (8 MB); power of two
+
+    def __init__(self, slots: Optional[int] = None):
+        n = self.SLOTS if slots is None else int(slots)
+        assert n & (n - 1) == 0, "slot count must be a power of two"
+        # LAZY: the 8 MB stamp array materializes on the first bump.
+        # Construction must stay ~free — index factories run inside
+        # the region-resync swap, where every extra millisecond widens
+        # the window lock-free readers can observe mid-rebuild (and a
+        # store that never writes a class shouldn't pay the pages).
+        self._clock: Optional[np.ndarray] = None
+        self._mask = np.int64(n - 1)
+        self._gen = 0
+        self._high = 0  # highest stamp handed out to a cell slot
+        self._floor = 0  # generation of the last wholesale bump_all
+        self._lock = threading.Lock()
+        self.incarnation = next(_INCARNATIONS)
+
+    def _slots_of(self, keys) -> np.ndarray:
+        return np.asarray(keys, np.int64).ravel() & self._mask
+
+    def bump(self, *key_arrays) -> None:
+        """One write: stamp every DAR key in the given arrays with a
+        fresh generation.  An UPDATE must pass both the old and the new
+        covering — a record that moved out of cell X changes X's
+        answers just as much as moving in."""
+        with self._lock:
+            self._gen += 1
+            g = self._gen
+            self._high = g
+            if self._clock is None:
+                self._clock = np.zeros(int(self._mask) + 1, np.int64)
+            for keys in key_arrays:
+                if keys is None:
+                    continue
+                self._clock[self._slots_of(keys)] = g
+
+    def bump_all(self) -> None:
+        """Wholesale invalidation (bulk_load / replayed snapshot):
+        raise the floor so every fence computed afterwards exceeds any
+        stamp handed out before — O(1), no per-record walk."""
+        with self._lock:
+            self._gen += 1
+            self._floor = self._gen
+
+    def fence(self, keys) -> "tuple[int, int, int, int]":
+        """-> (incarnation, max stamp over keys, generation, floor).
+        One vectorized gather+max per lookup; lock-free.  The floor is
+        the generation of the last WHOLESALE invalidation: the cache's
+        bounded-stale tolerance must refuse entries stamped before it
+        (a bump_all advances the generation by one but represents
+        unbounded change — counting it as one write of lag would let
+        a stale hit serve the entire pre-replacement dataset)."""
+        arr = self._clock  # one read: bump may swap it in concurrently
+        m = self._floor
+        if arr is not None:
+            slots = self._slots_of(keys)
+            if len(slots):
+                m = max(m, int(arr[slots].max()))
+        return (self.incarnation, m, self._gen, self._floor)
+
+    @property
+    def generation(self) -> int:
+        """Total write operations (cell-stamping AND wholesale)."""
+        return self._gen
+
+    @property
+    def high_water(self) -> int:
+        """Highest stamp handed out to a cell slot — the generation of
+        the last cell-stamping write.  Diverges from `generation` when
+        wholesale invalidations (bump_all) have run since."""
+        return self._high
 
 
 class TierSnapshot(NamedTuple):

@@ -54,6 +54,22 @@ RADIUS_EARTH_METER = 6371010.0
 _MAX_COVERING_CELLS = 100_000
 
 
+def canonical_cells(cells) -> np.ndarray:
+    """THE canonical covering form: sorted, deduped uint64 cell ids.
+
+    Applied once at query ingress (RID `_area_to_cells`, SCD
+    `Volume3D.calculate_covering`) and assumed by everything
+    downstream — the read cache keys on the covering's bytes and the
+    DAR pack path sorts per-row — so two syntactically different
+    requests for the same area hit the same cache line and the same
+    pack layout.  Already-canonical input (the common case: the BFS
+    coverings come out sorted-unique) is returned as-is, no copy."""
+    a = np.ascontiguousarray(np.asarray(cells, dtype=np.uint64).ravel())
+    if len(a) > 1 and not bool(np.all(a[1:] > a[:-1])):
+        return np.unique(a)
+    return a
+
+
 class AreaTooLargeError(Exception):
     """Requested area exceeds MAX_AREA_KM2 (maps to HTTP 413)."""
 

@@ -70,7 +70,11 @@ class Volume3D:
     def calculate_covering(self) -> np.ndarray:
         if self.footprint is None:
             raise ValueError("missing footprint")
-        return self.footprint.calculate_covering()
+        # canonical (sorted, deduped) at ingress — one covering form
+        # shared by read-cache keying and the DAR pack path
+        return geo_covering.canonical_cells(
+            self.footprint.calculate_covering()
+        )
 
 
 @dataclass

@@ -288,6 +288,7 @@ class ShardedReplica:
         self._shadow: Dict[str, set] = {c: set() for c in CLASSES}
         self._owners: Dict[str, int] = {}
         self._dirty = {c: False for c in CLASSES}
+        self._gen = {c: 0 for c in CLASSES}  # tail-applied write gen
         self._mu = threading.Lock()  # guards records + tail + rebuild
         # serializes whole refresh() runs: publish order must match
         # build order (the warmup happens outside _mu, so without this
@@ -365,6 +366,11 @@ class ShardedReplica:
             self._shadow[cls].add(rec.entity_id)  # newer than base
         self._delta[cls][rec.entity_id] = rec
         self._dirty[cls] = True
+        # per-class write generation: tail application IS the replica's
+        # write path, so the freshness surface (/status, stats) can
+        # compare replica generations against the primary's cell-clock
+        # generations when verifying fence behaviour
+        self._gen[cls] += 1
 
     def _del(self, cls: str, eid: str) -> None:
         if self._records[cls].pop(eid, None) is not None:
@@ -372,6 +378,7 @@ class ShardedReplica:
             if eid in self._base[cls]:
                 self._shadow[cls].add(eid)
             self._dirty[cls] = True
+            self._gen[cls] += 1
 
     def _apply_locked(self, rec: dict) -> None:
         t = rec.get("t", "")
@@ -401,6 +408,7 @@ class ShardedReplica:
                 self._delta[c] = {}
                 self._shadow[c] = set()
                 self._dirty[c] = True
+                self._gen[c] += 1
         elif t == "scd_op_put":
             self._put("ops", self._rec_from_op_doc(rec["doc"]))
         elif t == "scd_op_del":
@@ -846,6 +854,7 @@ class ShardedReplica:
                 0 if snap is None else len(snap.shadow)
             )
             out[f"replica_{cls}_dirty"] = int(self._dirty[cls])
+            out[f"replica_{cls}_generation"] = self._gen[cls]
         return out
 
 

@@ -195,6 +195,13 @@ class RegionClient:
         if self._seen_epoch is not None:
             self._epoch = self._seen_epoch
 
+    def current_epoch(self) -> str:
+        """The epoch this client's local state is built against — the
+        region component of the read cache's version fence: entries
+        stamped under an older epoch (a promotion, a restored-backup
+        rotation) can never be served after the flip."""
+        return self._epoch or ""
+
     @staticmethod
     def _json(r) -> dict:
         """Parse a response body, tolerating non-JSON error pages."""

@@ -28,7 +28,11 @@ MAX_SUBSCRIPTIONS_PER_AREA = 10  # DSS0030 (pkg/rid/application/subscription.go)
 
 def _area_to_cells(area: str) -> np.ndarray:
     try:
-        return geo_covering.area_to_cell_ids(area)
+        # canonical (sorted, deduped) at ingress: cache keying and the
+        # pack path share one covering form (geo_covering.canonical_cells)
+        return geo_covering.canonical_cells(
+            geo_covering.area_to_cell_ids(area)
+        )
     except geo_covering.AreaTooLargeError as e:
         raise errors.area_too_large(f"bad area: {e}")
     except geo_covering.BadAreaError as e:

@@ -249,6 +249,43 @@ def test_grafana_and_rules_cover_resident_kernel():
     assert "co_res_rejected" in alerts["DssResidentRingSaturated"]
 
 
+def test_grafana_and_rules_cover_read_cache():
+    """The version-fenced read cache must stay observable: a hit-rate
+    panel over the co_cache_* / dss_cache_* gauges, a churn panel
+    (entries/bytes/evictions/invalidations), and a DssCacheThrashing
+    alert on sustained invalidation rate ~ miss rate (writes killing
+    entries as fast as polls repopulate them)."""
+    dash = json.load(
+        open(os.path.join(ROOT, "deploy/grafana/dss-dashboard.json"))
+    )
+    exprs = [
+        t["expr"]
+        for p in dash["panels"]
+        for t in p.get("targets", [])
+    ]
+    for needed in (
+        "dss_cache_hits",
+        "dss_cache_misses",
+        "dss_cache_evictions",
+        "dss_cache_invalidations",
+        "dss_cache_entries",
+        "dss_cache_bytes",
+        "co_cache_hits",
+    ):
+        assert any(needed in e for e in exprs), needed
+    rules = yaml.safe_load(
+        open(os.path.join(ROOT, "deploy/prometheus/rules.yaml"))
+    )
+    alerts = {
+        r.get("alert"): r["expr"]
+        for g in rules["groups"]
+        for r in g["rules"]
+    }
+    assert "DssCacheThrashing" in alerts
+    assert "dss_cache_invalidations" in alerts["DssCacheThrashing"]
+    assert "dss_cache_misses" in alerts["DssCacheThrashing"]
+
+
 def test_make_certs_provisions_trust_material(tmp_path):
     """deploy/make_certs.py (the reference's build/make-certs.py +
     apply-certs.sh analog): JWT keypair, region token, TLS CA chain,

@@ -1,0 +1,524 @@
+"""Version-fenced read cache (dar/readcache.py): fence semantics,
+bit-identity with the fresh path, and the coalescer-bypass contract.
+
+The fence rules under test are the whole correctness story:
+  - epoch change -> rejected (region promotion / restore),
+  - index incarnation change -> rejected (resync replaces the index),
+  - ANY covering cell's clock advancing -> rejected (exact
+    invalidation by the existing write path; never a TTL),
+  - time only ever EXPIRES records out of a cached answer (t_end >=
+    now re-applied on every hit), never resurrects them,
+  - allow_stale hits tolerate a bounded generation lag, strict hits
+    tolerate none,
+  - a hit performs ZERO coalescer enqueues and ZERO device
+    dispatches (co_* counters frozen across it).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import uuid
+from datetime import datetime, timedelta, timezone
+
+import numpy as np
+import pytest
+
+from dss_tpu.clock import FakeClock
+from dss_tpu.dar import readcache as rcache
+from dss_tpu.dar.dss_store import DSSStore
+from dss_tpu.dar.tiers import CellClock
+from dss_tpu.geo.covering import canonical_cells
+from dss_tpu.geo.s2cell import dar_key_to_cell
+from dss_tpu.models import rid as ridm
+
+T0 = datetime(2026, 1, 1, tzinfo=timezone.utc)
+
+
+def _uuid(i: int) -> str:
+    return str(uuid.UUID(int=i, version=4))
+
+
+def _isa(i: int, cells, *, start=None, end=None, owner="u1", version=None):
+    return ridm.IdentificationServiceArea(
+        id=_uuid(i),
+        owner=owner,
+        url="https://uss.example/f",
+        cells=np.asarray(cells, np.uint64),
+        start_time=start or T0,
+        end_time=end or (T0 + timedelta(hours=12)),
+        altitude_lo=0.0,
+        altitude_hi=3000.0,
+        version=version,
+    )
+
+
+def _cells(lo: int, hi: int) -> np.ndarray:
+    return dar_key_to_cell(np.arange(lo, hi, dtype=np.int64))
+
+
+def _ids(records) -> list:
+    return sorted(r.id for r in records)
+
+
+@pytest.fixture(params=["memory", "tpu"])
+def store(request):
+    s = DSSStore(storage=request.param, clock=FakeClock(T0))
+    yield s
+    s.close()
+
+
+# -- CellClock unit behaviour -------------------------------------------------
+
+
+def test_cell_clock_bump_and_fence():
+    c = CellClock()
+    keys = np.arange(5, dtype=np.int32)
+    inc, m, gen, floor = c.fence(keys)
+    assert (m, gen, floor) == (0, 0, 0)
+    c.bump(np.asarray([1, 2], np.int32))
+    inc2, m2, gen2, _ = c.fence(keys)
+    assert inc2 == inc and m2 == 1 and gen2 == 1
+    # disjoint cells: the fence over {3, 4} does not move
+    _, m3, _, _ = c.fence(np.asarray([3, 4], np.int32))
+    assert m3 == 0
+    # old + new coverings both stamp
+    c.bump(np.asarray([3], np.int32), np.asarray([4], np.int32))
+    _, m4, _, _ = c.fence(np.asarray([3], np.int32))
+    _, m5, _, _ = c.fence(np.asarray([4], np.int32))
+    assert m4 == m5 == 2
+
+
+def test_cell_clock_floor_invalidates_everything():
+    c = CellClock()
+    c.bump(np.asarray([7], np.int32))
+    _, before, _, _ = c.fence(np.asarray([99], np.int32))
+    assert before == 0  # untouched cell
+    assert c.high_water == c.generation == 1
+    c.bump_all()
+    _, after, gen, floor = c.fence(np.asarray([99], np.int32))
+    assert after > before  # the floor moved past every older stamp
+    assert floor == gen == 2
+    # high_water tracks cell stamps only: it diverges from generation
+    # across wholesale invalidations (the two /status gauges are NOT
+    # duplicates)
+    assert c.high_water == 1
+
+
+def test_cell_clock_incarnations_are_unique():
+    assert CellClock().incarnation != CellClock().incarnation
+
+
+# -- LRU mechanics ------------------------------------------------------------
+
+
+def test_lru_eviction_counts_and_bounds():
+    rc = rcache.ReadCache(capacity=4, shards=1)
+    fence = (1, 0, 0, 0)
+    for i in range(8):
+        rc.insert("isa", ("k", i), fence, "", 0, [f"id{i}"], [10])
+    st = rc.stats()
+    assert st["entries"] == 4
+    assert st["evictions"] == 4
+    assert st["bytes"] > 0
+
+
+def test_disabled_cache_is_inert():
+    rc = rcache.ReadCache(enabled=False)
+    rc.insert("isa", "k", (1, 0, 0, 0), "", 0, ["a"], [10])
+    assert rc.lookup("isa", "k", (1, 0, 0, 0), "", 0) is None
+    assert rc.stats()["entries"] == 0
+
+
+def test_configure_disable_flushes():
+    rc = rcache.ReadCache()
+    rc.insert("isa", "k", (1, 0, 0, 0), "", 0, ["a"], [10])
+    assert rc.stats()["entries"] == 1
+    rc.configure(enabled=False)
+    assert rc.stats()["entries"] == 0
+    rc.configure(enabled=True)
+    assert rc.lookup("isa", "k", (1, 0, 0, 0), "", 0) is None
+
+
+# -- fence rejection (unit) ---------------------------------------------------
+
+
+def test_fence_rejects_epoch_change():
+    rc = rcache.ReadCache()
+    rc.insert("isa", "k", (1, 5, 5, 0), "epoch-a", 0, ["a"], [10])
+    assert rc.lookup("isa", "k", (1, 5, 5, 0), "epoch-b", 0) is None
+    assert rc.stats()["invalidations"] == 1
+    # and the entry is gone, not just skipped
+    assert rc.stats()["entries"] == 0
+
+
+def test_fence_rejects_incarnation_change():
+    rc = rcache.ReadCache()
+    rc.insert("isa", "k", (1, 5, 5, 0), "", 0, ["a"], [10])
+    assert rc.lookup("isa", "k", (2, 5, 5, 0), "", 0) is None
+    assert rc.stats()["invalidations"] == 1
+
+
+def test_fence_rejects_single_cell_clock_advance():
+    rc = rcache.ReadCache()
+    rc.insert("isa", "k", (1, 5, 5, 0), "", 0, ["a"], [10])
+    # one cell in the covering advanced past the stamped max
+    assert rc.lookup("isa", "k", (1, 6, 6, 0), "", 0) is None
+    assert rc.stats()["invalidations"] == 1
+
+
+def test_stale_lag_tolerates_bounded_generation_lag():
+    rc = rcache.ReadCache(stale_lag=2)
+    rc.insert("isa", "k", (1, 5, 5, 0), "", 0, ["a"], [10])
+    # strict lookup: rejected on any advance
+    assert rc.lookup("isa", "k", (1, 6, 6, 0), "", 0) is None
+    rc.insert("isa", "k", (1, 5, 5, 0), "", 0, ["a"], [10])
+    # allow_stale within the lag: served
+    assert rc.lookup(
+        "isa", "k", (1, 6, 7, 0), "", 0, allow_stale=True
+    ) == ["a"]
+    assert rc.stats()["stale_hits"] == 1
+    # allow_stale beyond the lag: rejected
+    assert rc.lookup(
+        "isa", "k", (1, 9, 8, 0), "", 0, allow_stale=True
+    ) is None
+
+
+def test_time_expiry_refilters_and_never_resurrects():
+    rc = rcache.ReadCache()
+    rc.insert(
+        "isa", "k", (1, 0, 0, 0), "", 100, ["a", "b", "c"],
+        [150, 200, 300],
+    )
+    assert rc.lookup("isa", "k", (1, 0, 0, 0), "", 100) == [
+        "a", "b", "c",
+    ]
+    # now advances: expired hits drop, order preserved
+    assert rc.lookup("isa", "k", (1, 0, 0, 0), "", 180) == ["b", "c"]
+    # now behind the entry's basis: must MISS (dropped records at the
+    # entry's now cannot be resurrected), entry stays for later polls
+    assert rc.lookup("isa", "k", (1, 0, 0, 0), "", 50) is None
+    assert rc.stats()["entries"] == 1
+    # and a backwards-clock re-populate must not displace the newer
+    # entry the lookup kept (same fence, older now0)
+    rc.insert("isa", "k", (1, 0, 0, 0), "", 50, ["a", "b", "c", "z"],
+              [150, 200, 300, 60])
+    assert rc.lookup("isa", "k", (1, 0, 0, 0), "", 180) == ["b", "c"]
+
+
+def test_stale_lag_never_crosses_a_wholesale_invalidation():
+    """bump_all advances the generation by ONE but stands for
+    unbounded change: allow_stale must refuse entries stamped before
+    the floor no matter how generous the lag."""
+    rc = rcache.ReadCache(stale_lag=100)
+    rc.insert("isa", "k", (1, 5, 5, 0), "", 0, ["a"], [10])
+    # cell advance within lag, no wholesale event: served stale
+    assert rc.lookup(
+        "isa", "k", (1, 6, 6, 0), "", 0, allow_stale=True
+    ) == ["a"]
+    # same lag, but a bump_all moved the floor past the entry's stamp
+    rc.insert("isa", "k", (1, 5, 5, 0), "", 0, ["a"], [10])
+    assert rc.lookup(
+        "isa", "k", (1, 7, 7, 7), "", 0, allow_stale=True
+    ) is None
+
+
+# -- store-level behaviour (both backends) ------------------------------------
+
+
+def test_repeat_poll_hits_and_is_bit_identical(store):
+    cells = _cells(100, 140)
+    store.rid.insert_isa(_isa(1, cells))
+    store.rid.insert_isa(_isa(2, cells[:10]))
+    e = T0 + timedelta(minutes=5)
+    fresh = _ids(store.rid.search_isas(cells, e, None))
+    assert fresh == [_uuid(1), _uuid(2)]
+    c0 = store.cache.stats()
+    again = _ids(store.rid.search_isas(cells, e, None))
+    c1 = store.cache.stats()
+    assert again == fresh
+    assert c1["hits"] == c0["hits"] + 1
+
+
+def test_write_in_covering_invalidates_then_repopulates(store):
+    cells = _cells(200, 232)
+    store.rid.insert_isa(_isa(3, cells))
+    e = T0 + timedelta(minutes=5)
+    store.rid.search_isas(cells, e, None)  # populate
+    # a write touching ONE cell of the covering invalidates the line
+    store.rid.insert_isa(_isa(4, cells[-1:]))
+    c0 = store.cache.stats()
+    got = _ids(store.rid.search_isas(cells, e, None))
+    c1 = store.cache.stats()
+    assert got == [_uuid(3), _uuid(4)]
+    assert c1["invalidations"] == c0["invalidations"] + 1
+    # and the refreshed line serves the new answer
+    assert _ids(store.rid.search_isas(cells, e, None)) == got
+    assert store.cache.stats()["hits"] > c1["hits"] - 1
+
+
+def test_disjoint_write_keeps_line_valid(store):
+    cells = _cells(300, 316)
+    store.rid.insert_isa(_isa(5, cells))
+    e = T0 + timedelta(minutes=5)
+    store.rid.search_isas(cells, e, None)
+    # write far away: this covering's clocks did not move
+    store.rid.insert_isa(_isa(6, _cells(9000, 9010)))
+    c0 = store.cache.stats()
+    got = _ids(store.rid.search_isas(cells, e, None))
+    c1 = store.cache.stats()
+    assert got == [_uuid(5)]
+    assert c1["hits"] == c0["hits"] + 1
+    assert c1["invalidations"] == c0["invalidations"]
+
+
+def test_delete_is_fenced_like_any_write(store):
+    cells = _cells(400, 420)
+    a = store.rid.insert_isa(_isa(7, cells))
+    e = T0 + timedelta(minutes=5)
+    assert _ids(store.rid.search_isas(cells, e, None)) == [_uuid(7)]
+    store.rid.search_isas(cells, e, None)  # ensure cached
+    assert store.rid.delete_isa(
+        dataclasses.replace(a, owner="u1")
+    ) is not None
+    assert _ids(store.rid.search_isas(cells, e, None)) == []
+
+
+def test_expiry_drops_from_cached_answer(store):
+    cells = _cells(500, 520)
+    soon = T0 + timedelta(minutes=30)
+    store.rid.insert_isa(_isa(8, cells, end=soon))
+    store.rid.insert_isa(
+        _isa(9, cells, end=T0 + timedelta(hours=10))
+    )
+    e = T0 + timedelta(minutes=5)
+    # populate the SCD-style wall-clock path: RID subs search uses
+    # wall-clock now; ISAs key on earliest.  Use search_subscriptions
+    # semantics via ops instead: ISA search keys on earliest, so
+    # advance earliest past the expiry and expect a different line —
+    # the wall-clock path is covered by the SCD test below.
+    assert _ids(store.rid.search_isas(cells, e, None)) == [
+        _uuid(8), _uuid(9),
+    ]
+    e2 = soon + timedelta(minutes=1)
+    assert _ids(store.rid.search_isas(cells, e2, None)) == [_uuid(9)]
+
+
+def test_scd_wallclock_expiry_refilters_cached_hit(store):
+    """SCD op searches use wall-clock `now`: a cached line must drop
+    records whose t_end passes BETWEEN polls, with no write at all."""
+    from dss_tpu.models import scd as scdm
+
+    cells = _cells(600, 616)
+    op = scdm.Operation(
+        id=_uuid(10),
+        owner="u1",
+        version=0,
+        start_time=T0,
+        end_time=T0 + timedelta(minutes=30),
+        altitude_lower=0.0,
+        altitude_upper=100.0,
+        cells=cells,
+        uss_base_url="https://u",
+        subscription_id=_uuid(99),
+        state="Accepted",
+    )
+    store.scd.upsert_operation(op, [], key_checked=True)
+    got = store.scd.search_operations(cells, None, None, None, None)
+    assert [o.id for o in got] == [_uuid(10)]
+    # poll again -> cached
+    c0 = store.cache.stats()
+    store.scd.search_operations(cells, None, None, None, None)
+    assert store.cache.stats()["hits"] == c0["hits"] + 1
+    # advance the WALL clock past the op's end: the cached line must
+    # re-filter it out exactly like the fresh path (op expired, no
+    # write happened, fence still valid)
+    store.clock.advance(minutes=45)
+    cached = store.scd.search_operations(cells, None, None, None, None)
+    assert cached == []
+    store.configure_serving(cache=False)
+    fresh = store.scd.search_operations(cells, None, None, None, None)
+    assert fresh == []
+
+
+def test_owner_scope_is_part_of_the_key(store):
+    cells = _cells(700, 716)
+    sub = ridm.Subscription(
+        id=_uuid(11), owner="alice", url="https://a",
+        cells=cells, start_time=T0,
+        end_time=T0 + timedelta(hours=1),
+    )
+    store.rid.insert_subscription(sub)
+    a = store.rid.search_subscriptions_by_owner(cells, "alice")
+    b = store.rid.search_subscriptions_by_owner(cells, "bob")
+    assert [s.id for s in a] == [_uuid(11)]
+    assert b == []
+    # repeat both: two separate cache lines, both hit
+    c0 = store.cache.stats()
+    a2 = store.rid.search_subscriptions_by_owner(cells, "alice")
+    b2 = store.rid.search_subscriptions_by_owner(cells, "bob")
+    c1 = store.cache.stats()
+    assert [s.id for s in a2] == [_uuid(11)] and b2 == []
+    assert c1["hits"] == c0["hits"] + 2
+
+
+def test_covering_order_is_canonicalized(store):
+    """Two syntactically different requests for the same area share a
+    cache line (the canonical-covering satellite)."""
+    cells = _cells(800, 816)
+    store.rid.insert_isa(_isa(12, cells))
+    e = T0 + timedelta(minutes=5)
+    shuffled = cells[::-1].copy()
+    dup = np.concatenate([cells, cells[:4]])
+    a = _ids(store.rid.search_isas(cells, e, None))
+    c0 = store.cache.stats()
+    b = _ids(store.rid.search_isas(shuffled, e, None))
+    c = _ids(store.rid.search_isas(dup, e, None))
+    c1 = store.cache.stats()
+    assert a == b == c == [_uuid(12)]
+    assert c1["hits"] == c0["hits"] + 2
+    assert c1["entries"] == c0["entries"]  # same line, not three
+
+
+def test_configure_serving_cache_toggle(store):
+    cells = _cells(900, 916)
+    store.rid.insert_isa(_isa(13, cells))
+    e = T0 + timedelta(minutes=5)
+    store.rid.search_isas(cells, e, None)
+    store.configure_serving(cache=False)
+    c0 = store.cache.stats()
+    assert c0["entries"] == 0 and c0["enabled"] == 0
+    got = _ids(store.rid.search_isas(cells, e, None))
+    assert got == [_uuid(13)]
+    assert store.cache.stats()["hits"] == c0["hits"]  # bypassed
+    store.configure_serving(cache=True)
+    store.rid.search_isas(cells, e, None)  # repopulate
+    c1 = store.cache.stats()
+    store.rid.search_isas(cells, e, None)
+    assert store.cache.stats()["hits"] == c1["hits"] + 1
+
+
+def test_reset_state_flushes_and_refences(store):
+    cells = _cells(1000, 1016)
+    store.rid.insert_isa(_isa(14, cells))
+    e = T0 + timedelta(minutes=5)
+    store.rid.search_isas(cells, e, None)
+    assert store.cache.stats()["entries"] >= 1
+    store.rid.reset_state()
+    assert store.cache.stats()["entries"] == 0
+    assert _ids(store.rid.search_isas(cells, e, None)) == []
+
+
+# -- the coalescer-bypass contract (tpu backend) ------------------------------
+
+
+def test_hit_performs_zero_coalescer_enqueues():
+    s = DSSStore(storage="tpu", clock=FakeClock(T0))
+    try:
+        cells = _cells(1100, 1132)
+        s.rid.insert_isa(_isa(15, cells))
+        e = T0 + timedelta(minutes=5)
+        s.rid.search_isas(cells, e, None)  # populate (fresh path)
+
+        def co_counters():
+            return {
+                k: v
+                for k, v in s.stats().items()
+                if k.endswith(
+                    ("co_batches", "co_items", "co_inline",
+                     "co_route_device_batches")
+                )
+            }
+
+        pre = co_counters()
+        c0 = s.cache.stats()
+        got = _ids(s.rid.search_isas(cells, e, None))
+        post = co_counters()
+        c1 = s.cache.stats()
+        assert got == [_uuid(15)]
+        assert c1["hits"] == c0["hits"] + 1
+        assert post == pre, f"hit touched the coalescer: {pre} -> {post}"
+        # per-class counters ride the coalescer stats path
+        st = s.stats()
+        assert st["dss_dar_isa_co_cache_hits"] >= 1
+    finally:
+        s.close()
+
+
+def test_freshness_note_records_hit_and_miss():
+    s = DSSStore(storage="memory", clock=FakeClock(T0))
+    try:
+        cells = _cells(1200, 1216)
+        s.rid.insert_isa(_isa(16, cells))
+        e = T0 + timedelta(minutes=5)
+        rcache.take_note()  # clean slate
+        s.rid.search_isas(cells, e, None)
+        n1 = rcache.take_note()
+        assert n1 is not None and n1["hit"] is False and n1["cls"] == "isa"
+        s.rid.search_isas(cells, e, None)
+        n2 = rcache.take_note()
+        assert n2 is not None and n2["hit"] is True
+        assert rcache.take_note() is None  # take clears
+    finally:
+        s.close()
+
+
+def test_http_freshness_header_and_status():
+    """Live socket: search responses carry X-DSS-Freshness (epoch +
+    generation + cache hit/miss) and GET /status reports per-class
+    generation + cell-clock high-water + cache counters — the
+    operator's fence-verification surface."""
+    import requests
+
+    from dss_tpu.api.app import build_app
+    from dss_tpu.services.rid import RIDService
+    from tests.live_server import LiveServer
+
+    clock = FakeClock(T0)
+    store = DSSStore(storage="memory", clock=clock)
+    app = build_app(
+        RIDService(store.rid, clock),
+        None,
+        None,  # no authorizer: anonymous (crypto-free harness)
+        enable_scd=False,
+        status_fn=store.freshness_status,
+    )
+    srv = LiveServer(app)
+    try:
+        cells = _cells(1300, 1316)
+        store.rid.insert_isa(_isa(17, cells))
+        area = "40,-100,40.05,-100,40.05,-99.95,40,-99.95"
+        t = (T0 + timedelta(minutes=5)).strftime("%Y-%m-%dT%H:%M:%SZ")
+        url = (
+            f"{srv.base}/v1/dss/identification_service_areas"
+            f"?area={area}&earliest_time={t}"
+        )
+        r1 = requests.get(url, timeout=10)
+        assert r1.status_code == 200, r1.text
+        f1 = r1.headers.get("X-DSS-Freshness", "")
+        assert "cache=miss" in f1 and "class=isa" in f1, f1
+        r2 = requests.get(url, timeout=10)
+        f2 = r2.headers.get("X-DSS-Freshness", "")
+        assert "cache=hit" in f2, f2
+        assert r2.json() == r1.json()
+        # gen=N is present and numeric
+        gen = [p for p in f2.split(";") if p.startswith("gen=")]
+        assert gen and int(gen[0][4:]) >= 0
+        st = requests.get(f"{srv.base}/status", timeout=10).json()
+        assert st["cache"]["hits"] >= 1
+        assert set(st["classes"]) == {"isa", "rid_sub", "op", "scd_sub"}
+        for c in st["classes"].values():
+            assert {"generation", "cell_clock_high_water",
+                    "live_records"} <= set(c)
+        assert st["epoch"] == ""  # standalone: no region epoch
+    finally:
+        srv.stop()
+        store.close()
+
+
+def test_canonical_cells_fast_path_and_dedup():
+    a = np.asarray([3, 1, 2, 2], np.uint64)
+    out = canonical_cells(a)
+    assert out.tolist() == [1, 2, 3]
+    srt = np.asarray([1, 2, 3], np.uint64)
+    # already canonical: returned as-is (a view at most, never a copy)
+    assert np.shares_memory(canonical_cells(srt), srt)

@@ -3,17 +3,24 @@ identical under random operation sequences.
 
 The store-contract tests pin known scenarios; this pins a longer tail:
 random interleavings of ISA create/delete, RID search, SCD operation
-put (with per-backend OVN keys)/delete, and SCD search on THREE
-backends — memory, tpu with aggressive TIERED snapshots (folds forced
-mid-sequence so queries constantly cross the L0/L1/overlay split), and
-tpu with tiering DISABLED (tier_ratio=0: every fold a full rebuild,
-the pre-tier single-snapshot path).  Outcomes (success vs exact error
-status/code), result-id sets, and notified-subscriber sets are
-compared; versions/OVNs are per-store commit-timestamp artifacts and
-are excluded.  The memory backend is a direct transliteration of the
-reference's SQL semantics (dar/oracle.py), so agreement here is
-agreement with the reference — and tiered agreeing with flat pins the
-tiering acceptance criterion (bit-identical results)."""
+put (with per-backend OVN keys)/delete, SCD search, and owner-scoped
+RID subscription search on FOUR backends — memory, tpu with aggressive
+TIERED snapshots (folds forced mid-sequence so queries constantly
+cross the L0/L1/overlay split), tpu with tiering DISABLED
+(tier_ratio=0: every fold a full rebuild, the pre-tier
+single-snapshot path), and memory with the read cache DISABLED.
+Outcomes (success vs exact error status/code), result-id sets, and
+notified-subscriber sets are compared; versions/OVNs are per-store
+commit-timestamp artifacts and are excluded.  The memory backend is a
+direct transliteration of the reference's SQL semantics
+(dar/oracle.py), so agreement here is agreement with the reference —
+tiered agreeing with flat pins the tiering acceptance criterion, and
+the CACHED stores (memory, tpu — search areas are quantized to a
+small grid so repeat polls actually hit) agreeing with
+the UNCACHED ones (memory_nocache, tpu_flat) pins the version-fence
+acceptance criterion: a cache hit is bit-identical to the fresh path
+under interleaved writes, folds, major compactions, owner-scoped
+queries, and tombstones."""
 
 from __future__ import annotations
 
@@ -58,9 +65,13 @@ def _extents(rng):
 
 
 def _search_area(rng):
-    lat = BASE_LAT + float(rng.uniform(0, 0.25))
-    lng = BASE_LNG + float(rng.uniform(0, 0.25))
-    h = float(rng.uniform(0.01, 0.05))
+    # QUANTIZED to a small grid: the poll model is many clients asking
+    # for the SAME areas, so fuzz searches repeat and the read cache's
+    # hit path is actually exercised (continuous draws would never
+    # repeat a covering and the fuzz would only ever test misses)
+    lat = BASE_LAT + 0.05 * int(rng.integers(0, 6))
+    lng = BASE_LNG + 0.05 * int(rng.integers(0, 6))
+    h = (0.02, 0.045)[int(rng.integers(0, 2))]
     return (
         f"{lat},{lng},{lat + h},{lng},{lat + h},{lng + h},{lat},{lng + h}"
     )
@@ -91,14 +102,31 @@ def test_backends_agree_under_random_ops(seed, monkeypatch):
     # "tpu": tiering forced aggressive (churn ratio 5 -> folds stay
     # minor, so the tier stack is live for most of the sequence);
     # "tpu_flat": tiering disabled (every fold a full single-snapshot
-    # rebuild) — the differential pin that tiered == single-snapshot
+    # rebuild) — the differential pin that tiered == single-snapshot.
+    # Cache split: memory + tpu run the version-fenced read cache,
+    # memory_nocache + tpu_flat run WITHOUT it — cached answers must
+    # be bit-identical to uncached ones on both backends.  Capacity
+    # comfortably exceeds the run's distinct-key count so the hits>0
+    # assertion below is deterministic: shard placement hashes key
+    # bytes with the PYTHONHASHSEED-randomized hash(), so a squeezed
+    # capacity would make eviction — and thus whether a repeat still
+    # finds its line — vary run to run (eviction behavior itself is
+    # pinned deterministically in test_readcache with shards=1).
+    monkeypatch.setenv("DSS_CACHE_ENABLE", "1")
+    monkeypatch.setenv("DSS_CACHE_CAP", "512")
     monkeypatch.setenv("DSS_TIER_RATIO", "5")
     tiered = DSSStore(storage="tpu")
+    mem_cached = DSSStore(storage="memory")
+    monkeypatch.setenv("DSS_CACHE_ENABLE", "0")
     monkeypatch.setenv("DSS_TIER_RATIO", "0")
     flat = DSSStore(storage="tpu")
+    mem_plain = DSSStore(storage="memory")
     monkeypatch.delenv("DSS_TIER_RATIO")
+    monkeypatch.delenv("DSS_CACHE_ENABLE")
+    monkeypatch.delenv("DSS_CACHE_CAP")
     stores = {
-        "memory": DSSStore(storage="memory"),
+        "memory": mem_cached,
+        "memory_nocache": mem_plain,
         "tpu": tiered,
         "tpu_flat": flat,
     }
@@ -118,7 +146,7 @@ def test_backends_agree_under_random_ops(seed, monkeypatch):
     rid_sub_versions: dict = {n: {} for n in stores}
 
     for step in range(90):
-        op = rng.integers(0, 9)
+        op = rng.integers(0, 10)
         sid = str(uuid.UUID(int=int(rng.integers(0, 40)), version=4))
         if op == 0:  # ISA create (fresh id, same for both backends)
             create_id = (
@@ -229,6 +257,15 @@ def test_backends_agree_under_random_ops(seed, monkeypatch):
                 )
                 for n in stores
             }
+        elif op == 9:  # owner-scoped RID subscription search (the
+            #             cache key carries the owner scope; two
+            #             owners must never share a line)
+            area = _search_area(rng)
+            owner = ("u1", "u2")[int(rng.integers(0, 2))]
+            outs = {
+                n: _norm_outcome(rid[n].search_subscriptions, area, owner)
+                for n in stores
+            }
         elif op == 8:  # ISA update with the CURRENT version (fencing)
             body = {"extents": _extents(rng), "flights_url": "https://u/f"}
             outs = {
@@ -290,6 +327,13 @@ def test_backends_agree_under_random_ops(seed, monkeypatch):
             }
             for n in others:
                 assert ids[n] == ids["memory"], (step, n, ids)
+        elif op == 9:
+            ids = {
+                n: sorted(s["id"] for s in r["subscriptions"])
+                for n, r in res.items()
+            }
+            for n in others:
+                assert ids[n] == ids["memory"], (step, n, ids)
         elif op in (0, 8):
             subs = {
                 n: sorted(
@@ -330,10 +374,17 @@ def test_backends_agree_under_random_ops(seed, monkeypatch):
         if step % 6 == 5:
             # force folds mid-sequence so later queries cross the tier
             # split (tiered) and the rebuilt snapshot (flat) — the
-            # overlay-only easy path must not be all the fuzz sees
-            for n in others:
+            # overlay-only easy path must not be all the fuzz sees.
+            # Every other round is a forced MAJOR compaction: cached
+            # entries must survive the full L0 rebuild untouched (the
+            # cell clock lives on the table, not in the snapshots).
+            major = (step // 6) % 2 == 1
+            for n in stores:
                 for t in _index_tables(stores[n]):
-                    t.fold()
+                    if major:
+                        t.compact()
+                    else:
+                        t.fold()
             max_tiers = max(
                 max_tiers,
                 max(
@@ -344,5 +395,14 @@ def test_backends_agree_under_random_ops(seed, monkeypatch):
 
     # the tiered backend must actually have served from >= 2 tiers
     assert max_tiers >= 2, "fuzz never exercised the tier stack"
+    # the CACHED stores must actually have served hits (quantized
+    # areas repeat), or the differential proved nothing about the
+    # fence; the uncached twins must never have consulted theirs
+    for n in ("memory", "tpu"):
+        assert stores[n].cache.stats()["hits"] > 0, (
+            n, stores[n].cache.stats(),
+        )
+    for n in ("memory_nocache", "tpu_flat"):
+        assert stores[n].cache.stats()["hits"] == 0
     for s in stores.values():
         s.close()
