@@ -15,7 +15,7 @@ native:
 	$(PY) -c "from dss_tpu import native; assert native.ensure_built(), 'g++ build failed'"
 
 test:
-	$(PY) -m pytest tests/ -q
+	$(PY) -m pytest tests/ -q -m "not slow"
 
 e2e:
 	./test/e2e.sh

@@ -1347,6 +1347,372 @@ def resident_smoke_leg():
     )
 
 
+def _skew_reexec(leg: str):
+    """The skew legs need the dp=1 x sp=8 virtual CPU mesh; when this
+    process's jax backend has fewer devices (the north-star run on a
+    real 1-chip backend), re-exec the leg in a subprocess with the
+    virtual-device env and relay its JSON verdict.  Returns the parsed
+    result dict, or None when this process can run the leg inline —
+    a real 8-device accelerator mesh runs it natively."""
+    import subprocess
+
+    if len(jax.devices()) >= 8:
+        return None
+    import re
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    want = "--xla_force_host_platform_device_count=8"
+    if "xla_force_host_platform_device_count" in flags:
+        # REPLACE an inherited smaller count (same pattern as
+        # multihost.initialize): merely appending would leave the
+        # child under 8 devices and re-execing forever
+        flags = re.sub(
+            r"--xla_force_host_platform_device_count=\d+", want, flags
+        )
+        env["XLA_FLAGS"] = flags
+    else:
+        env["XLA_FLAGS"] = (flags + " " + want).strip()
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--leg", leg],
+        env=env, capture_output=True, text=True, timeout=1800,
+    )
+    line = ""
+    for ln in proc.stdout.splitlines():
+        if ln.startswith("{"):
+            line = ln
+    if proc.returncode != 0 or not line:
+        raise RuntimeError(
+            f"skew subprocess failed (rc={proc.returncode}):\n"
+            f"{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}"
+        )
+    return json.loads(line)
+
+
+def _skew_replica(records, *, max_results=256, shard_results=48,
+                  load_shift=2, rebalance_ratio=1.5):
+    """A ShardedReplica over an 8-virtual-device (dp=1, sp=8) mesh
+    with `records` injected directly as the isas class (the leg
+    measures the mesh query path + placement search, not WAL codec
+    ingestion).  shard_results < max_results on purpose: it is the
+    per-shard result capacity a hot range can blow when placement
+    concentrates it on one shard — per-query exact host fallback, the
+    real latency cliff skew-aware splitting removes."""
+    import tempfile
+
+    from dss_tpu.dar.tiers import RangeLoad
+    from dss_tpu.parallel import make_mesh
+    from dss_tpu.parallel.replica import ShardedReplica
+
+    mesh = make_mesh(8, dp=1, sp=8)
+    wal = os.path.join(
+        tempfile.mkdtemp(prefix="dss-skew-"), "empty.wal"
+    )
+    open(wal, "w").close()
+    rep = ShardedReplica(
+        mesh,
+        wal_path=wal,
+        max_results=max_results,
+        shard_results=shard_results,
+        rebalance_ratio=rebalance_ratio,
+        move_interval_s=0.0,
+    )
+    rep.load = RangeLoad(shift=load_shift, decay_factor=0.5)
+    with rep._mu:
+        rep._records["isas"] = {r.entity_id: r for r in records}
+        rep._dirty["isas"] = True
+    rep.refresh(plan=False)
+    return rep
+
+
+def _mk_skew_fixture(n_cold, n_hot, n_areas, seed=7):
+    """Cold entities uniform over a wide key space plus one hot metro:
+    n_hot entities concentrated in a narrow contiguous key range.
+    Areas: rank-0 covers the hot range; the rest are uniform cold
+    windows.  Returns (records, areas)."""
+    from dss_tpu.dar.oracle import Record
+
+    rng = np.random.default_rng(seed)
+    key_space = 50_000
+    hot_lo = 21_000  # mid-space: inside one equal-count shard's range
+    hot_w = 64
+    recs = []
+    for i in range(n_cold):
+        k0 = int(rng.integers(0, key_space - 16))
+        keys = np.unique(
+            rng.integers(k0, k0 + 16, 4).astype(np.int32)
+        )
+        recs.append(Record(
+            entity_id=f"c{i}", keys=keys, alt_lo=0.0, alt_hi=3000.0,
+            t_start=-(2**62), t_end=2**62, owner_id=0,
+        ))
+    for i in range(n_hot):
+        k0 = hot_lo + int(rng.integers(0, hot_w - 4))
+        keys = np.unique(
+            rng.integers(k0, k0 + 4, 3).astype(np.int32)
+        )
+        recs.append(Record(
+            entity_id=f"h{i}", keys=keys, alt_lo=0.0, alt_hi=3000.0,
+            t_start=-(2**62), t_end=2**62, owner_id=0,
+        ))
+    areas = [np.arange(hot_lo, hot_lo + hot_w, dtype=np.int32)]
+    for _ in range(n_areas - 1):
+        k0 = int(rng.integers(0, key_space - 24))
+        areas.append(np.arange(k0, k0 + 24, dtype=np.int32))
+    return recs, areas
+
+
+def _zipf_ranks(rng, n_areas, alpha, n):
+    """n area indices, rank-biased: P(rank r) ~ (r+1)^-alpha (alpha=0
+    = uniform; the hot metro is rank 0)."""
+    p = (np.arange(1, n_areas + 1, dtype=np.float64)) ** (-alpha)
+    p /= p.sum()
+    return rng.choice(n_areas, size=n, p=p)
+
+
+def _skew_pass(rep, areas, picks, *, now=0):
+    """Serial single-query pass (each query is one mesh dispatch —
+    the per-query latency distribution is the point); -> latencies ms,
+    overflow fallbacks incurred, measured per-shard hit work."""
+    lat = []
+    snap = rep._snapshots["isas"]
+    ovf0 = sum(
+        d.overflow_fallbacks
+        for d in (snap.base, snap.delta) if d is not None
+    )
+    hits0 = rep.measured_shard_loads().copy()
+    for a in picks:
+        t0 = time.perf_counter()
+        rep.query_batch(
+            [areas[a]],
+            np.full(1, -np.inf, np.float32),
+            np.full(1, np.inf, np.float32),
+            np.full(1, -(2**62), np.int64),
+            np.full(1, 2**62, np.int64),
+            now=now, cls="isas",
+        )
+        lat.append((time.perf_counter() - t0) * 1000)
+    snap = rep._snapshots["isas"]
+    ovf = sum(
+        d.overflow_fallbacks
+        for d in (snap.base, snap.delta) if d is not None
+    ) - ovf0
+    work = rep.measured_shard_loads() - hits0
+    return np.asarray(lat), ovf, work
+
+
+def skew_leg(emit: bool = True):
+    """Zipf hot-spot sweep (`bench.py --leg skew`; also folded into
+    the north-star JSON): per-query mesh latency at
+    DSS_BENCH_ZIPF_ALPHAS (default 0, 0.8, 1.2) with load-weighted
+    shard rebalancing ON vs OFF on the SAME store.  Reports p50/p99
+    per alpha per mode plus the measured per-shard imbalance factor
+    (from the kernels' per-shard hit counts).  The acceptance bar:
+    rebalancing-ON p99 at alpha=1.2 within 1.5x of the uniform-load
+    p99, with static placement measurably worse (the hot range
+    concentrated on one shard blows the per-shard result capacity and
+    falls back to exact host scans)."""
+    sub = _skew_reexec("skew")
+    if sub is not None:
+        if emit:
+            print(json.dumps(sub))
+        return sub["detail"]
+    from dss_tpu.dar.tiers import RangeLoad
+    from dss_tpu.parallel.sharded import imbalance_factor
+
+    alphas = [
+        float(x)
+        for x in os.environ.get(
+            "DSS_BENCH_ZIPF_ALPHAS", "0,0.8,1.2"
+        ).split(",")
+    ]
+    n_cold = int(os.environ.get("DSS_BENCH_SKEW_COLD", 3000))
+    n_hot = int(os.environ.get("DSS_BENCH_SKEW_HOT", 120))
+    n_areas = int(os.environ.get("DSS_BENCH_SKEW_AREAS", 64))
+    n_q = int(os.environ.get("DSS_BENCH_SKEW_QUERIES", 250))
+    recs, areas = _mk_skew_fixture(n_cold, n_hot, n_areas)
+    rep = _skew_replica(recs)
+    per_alpha = {}
+    try:
+        for alpha in alphas:
+            rng = np.random.default_rng(int(alpha * 10) + 1)
+            picks = _zipf_ranks(rng, n_areas, alpha, n_q)
+
+            # -- OFF: static equal-count placement --------------------
+            rep.load = RangeLoad(shift=2, decay_factor=0.5)
+            rep.rebalance_ratio = 0.0
+            if rep.boundaries is not None:
+                rep.boundaries = None
+                with rep._mu:
+                    rep._force_major["isas"] = True
+                    rep._dirty["isas"] = True
+                rep.refresh(plan=False)
+            warm = _zipf_ranks(rng, n_areas, alpha, 16)
+            _skew_pass(rep, areas, warm)  # jit warm, not measured
+            lat_off, ovf_off, work_off = _skew_pass(rep, areas, picks)
+
+            # -- ON: measure load, rebalance at the fold, re-measure --
+            rep.load = RangeLoad(shift=2, decay_factor=0.5)
+            rep.rebalance_ratio = 1.5
+            _skew_pass(rep, areas, picks)  # the load-measurement pass
+            moves0 = rep.boundary_moves
+            rep.plan_rebalance()
+            imb_before = rep._imbalance
+            rep.refresh(plan=False)
+            _skew_pass(rep, areas, warm)  # warm the new split's jit
+            lat_on, ovf_on, work_on = _skew_pass(rep, areas, picks)
+            rep.plan_rebalance()  # recompute under the new boundaries
+
+            per_alpha[str(alpha)] = {
+                "off": {
+                    "p50_ms": round(float(np.percentile(lat_off, 50)), 3),
+                    "p99_ms": round(float(np.percentile(lat_off, 99)), 3),
+                    "overflow_fallbacks": int(ovf_off),
+                    "measured_imbalance": round(
+                        imbalance_factor(work_off), 3
+                    ),
+                },
+                "on": {
+                    "p50_ms": round(float(np.percentile(lat_on, 50)), 3),
+                    "p99_ms": round(float(np.percentile(lat_on, 99)), 3),
+                    "overflow_fallbacks": int(ovf_on),
+                    "measured_imbalance": round(
+                        imbalance_factor(work_on), 3
+                    ),
+                    "boundary_moves": rep.boundary_moves - moves0,
+                    "imbalance_before_move": round(imb_before, 3),
+                    "imbalance_after_move": round(rep._imbalance, 3),
+                },
+            }
+    finally:
+        rep.close()
+    uni = per_alpha.get("0.0") or per_alpha.get(str(alphas[0]))
+    hotk = str(alphas[-1])
+    result = {
+        "alphas": alphas,
+        "cold_entities": n_cold,
+        "hot_entities": n_hot,
+        "areas": n_areas,
+        "queries_per_pass": n_q,
+        "per_alpha": per_alpha,
+        # the acceptance ratios, stated directly
+        "on_p99_vs_uniform": round(
+            per_alpha[hotk]["on"]["p99_ms"]
+            / max(uni["on"]["p99_ms"], 1e-9), 3,
+        ),
+        "off_p99_vs_on_at_hot": round(
+            per_alpha[hotk]["off"]["p99_ms"]
+            / max(per_alpha[hotk]["on"]["p99_ms"], 1e-9), 3,
+        ),
+    }
+    if emit:
+        print(json.dumps({
+            "metric": "skew_on_p99_vs_uniform",
+            "value": result["on_p99_vs_uniform"],
+            "unit": "x",
+            "detail": result,
+        }))
+    return result
+
+
+def skew_smoke_leg():
+    """CI skew smoke (`bench.py --leg skew-smoke`): the deterministic
+    hot-spot chain — one hot key range hammered -> imbalance detected
+    above DSS_SHARD_REBALANCE_RATIO -> boundaries move at the fold ->
+    measured imbalance recovers -> answers bit-identical before and
+    after the move, and the static run pays overflow fallbacks the
+    rebalanced run does not.  Exits nonzero if any link fails."""
+    sub = _skew_reexec("skew-smoke")
+    if sub is not None:
+        print(json.dumps(sub))
+        return 0 if sub.get("value") == 1 else 1
+    from dss_tpu.dar.tiers import RangeLoad
+
+    recs, areas = _mk_skew_fixture(1200, 100, 16)
+    rep = _skew_replica(recs, shard_results=32)
+    errors = []
+    try:
+        hot = areas[0]
+
+        def run_hot():
+            return rep.query_batch(
+                [hot],
+                np.full(1, -np.inf, np.float32),
+                np.full(1, np.inf, np.float32),
+                np.full(1, -(2**62), np.int64),
+                np.full(1, 2**62, np.int64),
+                now=0, cls="isas",
+            )
+
+        before = run_hot()
+        if not before[0]:
+            errors.append("hot query returned nothing")
+        snap = rep._snapshots["isas"]
+        ovf_static = snap.base.overflow_fallbacks
+        if ovf_static == 0:
+            errors.append(
+                "static placement never overflowed the per-shard "
+                "capacity: the smoke fixture is too small to prove "
+                "the cliff"
+            )
+        # hammer the hot range (the load the rebalancer plans from)
+        rep.load = RangeLoad(shift=2, decay_factor=0.5)
+        for _ in range(30):
+            rep.load.record(hot, work=100.0)
+        moved = rep.plan_rebalance()
+        imb_before = rep._imbalance
+        if not moved:
+            errors.append(
+                f"no boundary move (imbalance {imb_before:.2f})"
+            )
+        if rep.boundary_moves != 1:
+            errors.append(f"boundary_moves {rep.boundary_moves} != 1")
+        rep.refresh(plan=False)
+        after = run_hot()
+        if before != after:
+            errors.append("answers changed across the boundary move")
+        snap = rep._snapshots["isas"]
+        ovf0 = snap.base.overflow_fallbacks
+        run_hot()
+        if snap.base.overflow_fallbacks != ovf0:
+            errors.append(
+                "rebalanced placement still pays exact-host overflow "
+                "fallbacks on the hot range"
+            )
+        rep.plan_rebalance()
+        if not rep._imbalance < imb_before:
+            errors.append(
+                f"imbalance did not recover: {imb_before:.2f} -> "
+                f"{rep._imbalance:.2f}"
+            )
+        # uniform load must NOT move boundaries (hysteresis)
+        rep.load = RangeLoad(shift=2, decay_factor=0.5)
+        rng = np.random.default_rng(3)
+        for _ in range(64):
+            a = areas[int(rng.integers(0, len(areas)))]
+            rep.load.record(a, work=2.0)
+        gen0 = rep.boundary_moves
+        rep.plan_rebalance()
+        if rep.boundary_moves != gen0:
+            errors.append("uniform load moved boundaries (no hysteresis)")
+    finally:
+        rep.close()
+    ok = not errors
+    print(json.dumps({
+        "metric": "skew_smoke",
+        "value": 1 if ok else 0,
+        "unit": "ok",
+        "detail": {
+            "errors": errors,
+            "boundary_moves": rep.boundary_moves,
+            "imbalance_before": round(imb_before, 3),
+            "imbalance_after": round(rep._imbalance, 3),
+        },
+    }))
+    return 0 if ok else 1
+
+
 def main():
     import argparse
 
@@ -1354,7 +1720,8 @@ def main():
     ap.add_argument(
         "--leg",
         choices=["north-star", "workers", "curve-smoke",
-                 "resident-smoke", "poll", "cache-smoke"],
+                 "resident-smoke", "poll", "cache-smoke", "skew",
+                 "skew-smoke"],
         default="north-star",
         help="'north-star': the headline SCD conflict-qps benchmark "
         "(default); 'workers': multi-worker HTTP serving scaling smoke "
@@ -1368,11 +1735,20 @@ def main():
         "the version-fenced read cache on vs off; 'cache-smoke': "
         "deterministic hit -> write-invalidate -> miss -> repopulate "
         "CI cycle asserting a hit is bit-identical and performs zero "
-        "coalescer enqueues",
+        "coalescer enqueues; 'skew': Zipf hot-spot sweep "
+        "(DSS_BENCH_ZIPF_ALPHAS) with load-weighted shard rebalancing "
+        "ON vs OFF on the same store, reporting p50/p99 + measured "
+        "imbalance factor; 'skew-smoke': deterministic hot cell -> "
+        "imbalance detected -> boundaries move -> imbalance recovers "
+        "CI chain",
     )
     args = ap.parse_args()
     if args.leg == "workers":
         return workers_leg()
+    if args.leg == "skew":
+        return 0 if skew_leg() else 1
+    if args.leg == "skew-smoke":
+        return skew_smoke_leg()
     if args.leg == "curve-smoke":
         return curve_smoke_leg()
     if args.leg == "resident-smoke":
@@ -1469,6 +1845,12 @@ def main():
         # so the recorded BENCH JSON carries it
         poll = poll_leg(emit=False)
 
+    skew = None
+    if do_serving and os.environ.get("DSS_BENCH_SKEW", "1") != "0":
+        # the Zipf hot-spot leg (load-weighted shard rebalancing on vs
+        # off on the same mesh store) rides the default run too
+        skew = skew_leg(emit=False)
+
     qps = h["qps"]
     result = {
         "metric": "scd_conflict_qps_1M_intents",
@@ -1510,6 +1892,10 @@ def main():
             # repeat-poll workload: the version-fenced read cache's
             # served-qps/hit-rate/p99 claim at ~100:1 poll:write
             "poll": poll,
+            # Zipf hot-spot workload: skew-aware shard placement's
+            # p99-under-skew claim (rebalancing on vs off, measured
+            # per-shard imbalance from the kernels' hit counts)
+            "skew": skew,
             "backend": jax.devices()[0].platform,
             "device": str(jax.devices()[0]),
             "pipeline": "DarTable snapshot; fused: host-searchsorted +"

@@ -6,8 +6,10 @@ reports the DCN seam's numbers: per-host refresh bytes/s (tier bytes
 each host materializes for its addressable shards per second of fold
 wall time) and cross-process query qps (every query's "sp" all_gather
 crosses the process boundary).  Emits one JSON line AND writes
-MULTICHIP_r06.json at the repo root with the acceptance verdict
-(`ok`, `num_processes`, bit-identical + degraded-failover checks).
+MULTICHIP_r07.json at the repo root with the acceptance verdict
+(`ok`, `num_processes`, bit-identical + degraded-failover checks, and
+the elasticity leg: forced hot-range boundary move, host join via
+snapshot+tail, graceful leave — all bit-identical).
 
   python benchmarks/bench_multihost.py
 Env: DSS_BENCH_MH_PROCS (2), DSS_BENCH_MH_DEVS (2 per process),
@@ -63,9 +65,16 @@ def main():
         "reference_query_qps": verdict.get("reference", {}).get(
             "query_qps"
         ),
+        # elasticity acceptance (skew-aware placement + membership):
+        # hot-range boundary move fired and answers held, p2 joined a
+        # live two-member mesh via snapshot+tail, then left again
+        "elastic_ok": verdict.get("elastic_ok"),
+        "hotmove": verdict.get("elastic", {}).get("hotmove"),
+        "join": verdict.get("elastic", {}).get("join"),
+        "leave": verdict.get("elastic", {}).get("leave"),
     }
     with open(
-        os.path.join(ROOT, "MULTICHIP_r06.json"), "w", encoding="utf-8"
+        os.path.join(ROOT, "MULTICHIP_r07.json"), "w", encoding="utf-8"
     ) as fh:
         json.dump(record, fh, indent=2)
         fh.write("\n")

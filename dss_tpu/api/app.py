@@ -528,7 +528,12 @@ def build_app(
                 # device work) — keep the event loop free
                 stats = await _call_r(request, stats_fn)
                 for name, val in stats.items():
-                    metrics.set_gauge(name, val)
+                    if isinstance(val, dict):
+                        # per-shard (or other keyed) gauge families —
+                        # e.g. dss_shard_load{shard="3"}
+                        metrics.set_gauge_vec(name, "shard", val)
+                    else:
+                        metrics.set_gauge(name, val)
             return web.Response(
                 text=metrics.render(),
                 content_type="text/plain",

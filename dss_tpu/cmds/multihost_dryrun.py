@@ -222,13 +222,27 @@ def worker(args) -> None:
 
     from dss_tpu.parallel.mesh import make_global_mesh
 
-    placement = make_global_mesh(dp=1)
+    # serving membership may be a subset of the provisioned world:
+    # non-member processes are standby slots that tail the log in
+    # lockstep (their snapshot+tail catch-up) until a reform joins
+    # them — the elastic-membership leg drives exactly that
+    members = (
+        tuple(int(x) for x in args.members.split(","))
+        if args.members
+        else tuple(range(args.num_processes))
+    )
+    placement = make_global_mesh(dp=1, processes=members)
     replica = mh.MultihostReplica(
         runtime,
         placement,
         wal_path=args.wal,
         warm_batches=(1,),
+        members=members,
     )
+    # the elastic leg forces a deterministic hot-range boundary move:
+    # lift the move-rate cap so the forced rebalance fires on the very
+    # next fold instead of waiting out the production default
+    replica._inner.move_interval_s = 0.0
 
     if not runtime.is_leader:
         # the peer-loss leg: the leader orders this follower to die
@@ -274,6 +288,91 @@ def worker(args) -> None:
     out["query_s"] = round(time.perf_counter() - t0, 3)
     out["queries"] = nq
     out["query_qps"] = round(nq / max(out["query_s"], 1e-9), 1)
+
+    if args.elastic and runtime.num_processes > 2:
+        import numpy as np
+
+        # -- forced hot-range boundary move -----------------------------------
+        # hammer one hot box so the load EWMA concentrates in its key
+        # range; the next sync detects the imbalance, broadcasts the
+        # new boundary map with the fold cut, and every member rebuilds
+        # under it — answers must not move a bit
+        inner = replica._inner
+        hot = keys_list[0]
+        for _ in range(40):
+            replica.query_batch(
+                [hot],
+                np.full(1, -np.inf, np.float32),
+                np.full(1, np.inf, np.float32),
+                np.full(1, -(2**62), np.int64),
+                np.full(1, 2**62, np.int64),
+                now=now,
+                cls="isas",
+            )
+        # the million-user hot spot, compressed: stamp the hot box's
+        # key range with heavy measured work (the same RangeLoad.record
+        # call the serving paths make, at a deterministic magnitude)
+        for _ in range(20):
+            inner.load.record(hot, work=200.0)
+        imb_before = None
+        inner.plan_rebalance()  # evaluates; may already move
+        imb_before = inner._imbalance
+        replica.sync()  # broadcasts boundaries with the fold cut
+        out["hotmove"] = {
+            "imbalance_before": round(imb_before, 3),
+            "boundary_moves": inner.boundary_moves,
+            "boundaries": (
+                None if inner.boundaries is None
+                else [int(x) for x in inner.boundaries]
+            ),
+        }
+        hot_res = _run_queries(replica, keys_list, now)
+        out["hotmove"]["match"] = hot_res == out["wave_b"]
+        # recovery: replan under the new boundaries — the measured
+        # imbalance must drop back under the threshold
+        inner.plan_rebalance()
+        out["hotmove"]["imbalance_after"] = round(inner._imbalance, 3)
+
+        # -- host join (snapshot+tail, cut in at the next fold) ---------------
+        joiner = runtime.num_processes - 1
+        new_members = tuple(
+            sorted(set(replica.members) | {joiner})
+        )
+        replica.set_members(new_members)
+        replica.sync()  # reform at this fold boundary
+        out["join"] = {
+            "members": list(replica.members),
+            "mesh": dict(replica.mesh.shape),
+            "placement": {
+                str(p): list(cols)
+                for p, cols in replica.placement.sp_by_process.items()
+            },
+        }
+        join_res = _run_queries(replica, keys_list, now)
+        out["join"]["match"] = join_res == out["wave_b"]
+        # a SECOND hot-range move AFTER the join: the reform reset
+        # boundary_gen on every process (joiner included), so this
+        # move's broadcast must drive the identical force-major
+        # decision on all three — the exact lockstep seam a stale
+        # generation would wedge
+        for _ in range(20):
+            inner.load.record(hot, work=200.0)
+        inner._last_decay = float("-inf")
+        replica.sync()
+        out["join"]["post_join_moves"] = inner.boundary_moves
+        out["join"]["post_join_match"] = (
+            _run_queries(replica, keys_list, now) == out["wave_b"]
+        )
+
+        # -- graceful leave (departing host's ranges redistribute) ------------
+        replica.set_members(tuple(m for m in new_members if m != joiner))
+        replica.sync()
+        out["leave"] = {
+            "members": list(replica.members),
+            "mesh": dict(replica.mesh.shape),
+        }
+        leave_res = _run_queries(replica, keys_list, now)
+        out["leave"]["match"] = leave_res == out["wave_b"]
 
     if args.peerloss and runtime.num_processes > 1:
         replica.broadcast_control("die")
@@ -335,6 +434,8 @@ def _run_leg(
     *,
     devices_per_process: int = 2,
     peerloss: bool = False,
+    members: str = "",
+    elastic: bool = False,
     reps: int = 3,
     watchdog_interval: float = 0.25,
     watchdog_timeout: float = 2.0,
@@ -357,6 +458,10 @@ def _run_leg(
     ]
     if peerloss:
         common.append("--peerloss")
+    if members:
+        common += ["--members", members]
+    if elastic:
+        common.append("--elastic")
     procs = []
     for i in range(num_processes):
         argv = ["--process_id", str(i), *common]
@@ -400,10 +505,13 @@ def run_dryrun(
     devices_per_process: int = 2,
     reps: int = 3,
     timeout_s: float = 600.0,
+    elastic: bool = True,
 ) -> dict:
     """The full acceptance: fixture -> single-process reference ->
     N-process mesh (bit-identical check) -> peer-loss leg (degraded
-    local-only check).  Returns the combined verdict dict."""
+    local-only check) -> elasticity leg (forced hot-range boundary
+    move, host join via snapshot+tail, graceful leave — all
+    bit-identical).  Returns the combined verdict dict."""
     os.makedirs(workdir, exist_ok=True)
     fixture = os.path.join(workdir, "fixture")
     os.makedirs(fixture, exist_ok=True)
@@ -453,7 +561,7 @@ def run_dryrun(
         and pl.get("host_only_match")
         and pl.get("local_mesh_match")
     )
-    return {
+    out = {
         "ok": bool(bit_identical and peerloss_ok),
         "num_processes": num_processes,
         "devices_per_process": devices_per_process,
@@ -463,6 +571,45 @@ def run_dryrun(
         "multi": multi["leader"],
         "peerloss": pl or {k: v for k, v in peer.items() if k != "leader"},
     }
+    if elastic:
+        # elasticity: a 3-slot world serving from 2 members — forced
+        # hot-range boundary move, p2 joins via its lockstep tail
+        # (snapshot+tail), then leaves again; every phase's answers
+        # must match BOTH wave_b and the single-process reference
+        el = _run_leg(
+            os.path.join(workdir, "elastic"),
+            fixture,
+            3,
+            devices_per_process=devices_per_process,
+            members="0,1",
+            elastic=True,
+            reps=1,
+            timeout_s=timeout_s,
+        )
+        ell = el.get("leader", {})
+        hm, jn, lv = (
+            ell.get("hotmove", {}), ell.get("join", {}), ell.get("leave", {})
+        )
+        elastic_ok = bool(
+            el["ok"]
+            and ell.get("wave_b") == ref["leader"]["wave_b"]
+            and hm.get("match")
+            and hm.get("boundary_moves", 0) >= 1
+            and hm.get("imbalance_after", 1e9)
+            < hm.get("imbalance_before", 0)
+            and jn.get("match")
+            and jn.get("post_join_match")
+            and jn.get("post_join_moves", 0) >= 2
+            and len(jn.get("members", [])) == 3
+            and lv.get("match")
+            and len(lv.get("members", [])) == 2
+        )
+        out["elastic_ok"] = elastic_ok
+        out["elastic"] = ell or {
+            k: v for k, v in el.items() if k != "leader"
+        }
+        out["ok"] = bool(out["ok"] and elastic_ok)
+    return out
 
 
 def main():
@@ -477,6 +624,16 @@ def main():
     ap.add_argument("--out", default="")
     ap.add_argument("--reps", type=int, default=3)
     ap.add_argument("--peerloss", action="store_true")
+    ap.add_argument(
+        "--members", default="",
+        help="csv of initial serving-mesh member process ids (default "
+        "all); non-members run standby, tailing the log until a join",
+    )
+    ap.add_argument(
+        "--elastic", action="store_true",
+        help="leader runs the elasticity schedule: forced hot-range "
+        "boundary move, host join via snapshot+tail, graceful leave",
+    )
     ap.add_argument("--watchdog_interval", type=float, default=0.25)
     ap.add_argument("--watchdog_timeout", type=float, default=2.0)
     ap.add_argument(

@@ -178,6 +178,16 @@ def make_parser() -> argparse.ArgumentParser:
         help="seconds between replica log polls / snapshot rebuilds",
     )
     p.add_argument(
+        "--no_shard_rebalance",
+        action="store_true",
+        help="pin the sharded replica to the static equal-count "
+        "postings split: disable the load-weighted boundary search "
+        "(equivalent to DSS_SHARD_REBALANCE_RATIO=0).  By default the "
+        "replica measures per-key-range query load and moves shard "
+        "boundaries at fold cuts when imbalance exceeds "
+        "DSS_SHARD_REBALANCE_RATIO (docs/OPERATIONS.md)",
+    )
+    p.add_argument(
         "--no_warmup",
         action="store_true",
         help="skip the background fused-kernel compile at startup",
@@ -523,6 +533,8 @@ def build(args) -> web.Application:
                 region_client=region_client,
                 warm_batches=warm,
             )
+            if args.no_shard_rebalance:
+                replica._inner.rebalance_ratio = 0.0
             if mh_runtime.is_leader:
                 replica.start(args.replica_refresh_interval)
                 store.attach_mesh_replica(replica)
@@ -559,6 +571,8 @@ def build(args) -> web.Application:
                 replica = ShardedReplica(
                     mesh, wal_path=args.wal_path, warm_batches=warm
                 )
+            if args.no_shard_rebalance:
+                replica.rebalance_ratio = 0.0
             replica.start(args.replica_refresh_interval)
             # oversized bounded-staleness search batches ride the mesh
             store.attach_mesh_replica(replica)

@@ -593,6 +593,10 @@ class QueryCoalescer:
         # optional read-cache counter view (set_cache_view): per-class
         # co_cache_* gauges merged into stats()
         self._cache_view = None
+        # optional per-key-range load accounting (set_load_view): every
+        # locally-served query stamps its covering's buckets, feeding
+        # the skew-aware shard rebalancer
+        self._load_view = None
         # optional multi-chip offload: big read-only batches can run on
         # a fresh ShardedReplica mesh instead of the local device
         self._mesh_fn = None
@@ -647,6 +651,16 @@ class QueryCoalescer:
         story (they bypass this pipeline entirely: no admission, no
         deadline stamp, no Retry-After backlog contribution)."""
         self._cache_view = fn
+
+    def set_load_view(self, load) -> None:
+        """Attach a tiers.RangeLoad: every query THIS pipeline serves
+        records its covering + measured result work into the per-key-
+        range load EWMA the skew-aware shard splitter plans from.
+        Only coalescer-served traffic counts by construction — read-
+        cache hits bypass the pipeline entirely and never reach a
+        shard, and mesh-offloaded batches are recorded by the replica
+        itself (its own serving entry), never double-counted here."""
+        self._load_view = load
 
     def set_mesh_delegate(self, fn, fresh_fn, min_batch: int = 64):
         """Route batches of >= min_batch bounded-staleness queries
@@ -1222,11 +1236,18 @@ class QueryCoalescer:
                 it.error = e
                 it.event.set()
 
-    @staticmethod
-    def _deliver_results(batch: List[_Item], results) -> None:
+    def _deliver_results(self, batch: List[_Item], results) -> None:
+        load = self._load_view
         for it, res in zip(batch, results):
             it.result = res
             it.event.set()
+            if load is not None and not it.via_mesh:
+                # after event.set() on purpose: load accounting must
+                # never add latency in front of a waiting caller
+                try:
+                    load.record(it.keys, len(res))
+                except Exception:  # noqa: BLE001 — metrics-only path
+                    pass
 
     def _enqueue_resident(self, batch: List[_Item]) -> bool:
         """Hand a drained batch to the resident loop's host ring.

@@ -1090,6 +1090,15 @@ class DSSStore:
         # instance fronting all four entity classes' search paths;
         # DSS_CACHE_* env knobs, configure_serving(cache=) at runtime
         self.cache = rcache.ReadCache(**rcache.env_knobs())
+        # per-key-range query-load EWMA (dar/tiers.py RangeLoad): one
+        # shared map across all four classes — they cover one S2 key
+        # space and the sharded replica plans ONE boundary map from it.
+        # Coalescer-served traffic stamps it below; attach_mesh_replica
+        # hands the same instance to the replica so its own serving
+        # entry accumulates into the same map.
+        from dss_tpu.dar import tiers as _tiersmod
+
+        self.range_load = _tiersmod.RangeLoad()
         ts = TimestampOracle(self.clock)
         owners = OwnerInterner()
         self.rid = RIDStoreImpl(
@@ -1130,6 +1139,7 @@ class DSSStore:
                 co.set_cache_view(
                     lambda cls=cls: self.cache.class_stats(cls)
                 )
+                co.set_load_view(self.range_load)
         self._replaying = False
         if region_url:
             self.region = RegionCoordinator(
@@ -1247,6 +1257,12 @@ class DSSStore:
             co.set_mesh_delegate(
                 make(cls), replica.fresh, min_batch=min_batch
             )
+        # one load map: coalescer-served AND replica-served traffic
+        # accumulate into the store's RangeLoad, which the replica's
+        # rebalancer plans from at fold boundaries
+        use_load = getattr(replica, "use_load", None)
+        if use_load is not None:
+            use_load(self.range_load)
 
     def close(self):
         if self.region is not None:
@@ -1275,6 +1291,10 @@ class DSSStore:
         # cache is enabled or not — dashboards expect the series)
         for k, v in self.cache.stats().items():
             out[f"dss_cache_{k}"] = v
+        # per-key-range load accounting (the skew-aware rebalancer's
+        # measurement input)
+        for k, v in self.range_load.stats().items():
+            out[f"dss_{k}"] = v
         if self.region is not None:
             out.update(self.region.stats())
         return out

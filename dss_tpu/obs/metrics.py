@@ -76,6 +76,7 @@ class MetricsRegistry:
         self._hist_sum: Dict[Tuple[str, str], float] = {}
         self._hist_cnt: Dict[Tuple[str, str], int] = {}
         self._gauges: Dict[str, float] = {}
+        self._gauge_vecs: Dict[str, Tuple[str, Dict[str, float]]] = {}
         self._scalar_counters: Dict[str, float] = {}
         self._infos: Dict[str, Dict[str, str]] = {}
         self._stage_sum: Dict[Tuple[str, str], float] = {}
@@ -110,6 +111,19 @@ class MetricsRegistry:
     def set_gauge(self, name: str, value: float) -> None:
         with self._lock:
             self._gauges[name] = float(value)
+
+    def set_gauge_vec(
+        self, name: str, label: str, values: Dict[str, float]
+    ) -> None:
+        """Labeled gauge family: <name>{<label>="<key>"} <value> per
+        entry (e.g. dss_shard_load{shard="3"} — the per-shard heat the
+        skew dashboard panel renders).  Each call replaces the whole
+        family, so a shard count change never leaves stale series."""
+        with self._lock:
+            self._gauge_vecs[name] = (
+                label,
+                {str(k): float(v) for k, v in values.items()},
+            )
 
     def set_counter(self, name: str, value: float) -> None:
         """Label-less monotonic counter exposed with the proper
@@ -209,4 +223,9 @@ class MetricsRegistry:
                     lines.append(f"{name}{{{pl}}} {v}")
                 else:
                     lines.append(f"{name} {v}")
+            for name, (label, vals) in sorted(self._gauge_vecs.items()):
+                lines.append(f"# TYPE {name} gauge")
+                for k, v in sorted(vals.items()):
+                    l = lab(f'{_esc_label(label)}="{_esc_label(k)}"')
+                    lines.append(f"{name}{{{l}}} {v}")
         return "\n".join(lines) + "\n"

@@ -22,8 +22,10 @@ from dss_tpu.parallel.mesh import (
 )
 from dss_tpu.parallel.sharded import (
     ShardedDar,
+    imbalance_factor,
     shard_postings,
     sharded_conflict_query_batch,
+    weighted_boundaries,
 )
 
 __all__ = [
@@ -32,6 +34,8 @@ __all__ = [
     "make_mesh",
     "mesh_spans_processes",
     "ShardedDar",
+    "imbalance_factor",
     "shard_postings",
     "sharded_conflict_query_batch",
+    "weighted_boundaries",
 ]

@@ -89,15 +89,28 @@ def make_global_mesh(
     dp: Optional[int] = None,
     sp: Optional[int] = None,
     devices: Optional[Sequence[jax.Device]] = None,
+    processes: Optional[Sequence[int]] = None,
 ) -> MeshPlacement:
     """Build the ("dp", "sp") mesh over the GLOBAL device list (every
     process's devices, jax.distributed-joined) with explicit placement.
 
-    Devices are ordered (process_index, id) and reshaped row-major, so
-    each process's devices land on CONTIGUOUS "sp" columns whenever
-    local device counts divide sp: a host then owns contiguous
+    Devices are ordered (process_index, id) and laid out COLUMN-blocked
+    per process: a process with k local devices owns k/dp whole,
+    contiguous "sp" columns, filled down the dp axis.  That makes the
+    contiguity the per-host fold accounting and tier-delta shipping
+    assume an enforced invariant, not a hope: a host owns contiguous
     postings ranges, per-host folds touch one contiguous block, and
-    the "sp" all_gather's inter-host hops are the DCN seam.
+    the "sp" all_gather's inter-host hops are the DCN seam.  A dp that
+    does not divide some process's local device count would scatter
+    that host's devices across columns other hosts also own (the old
+    row-major reshape did exactly this silently) — now it FAILS
+    LOUDLY instead of producing a placement whose owner map lies.
+
+    `processes` restricts the mesh to those processes' devices — the
+    elastic-membership surface: the jax.distributed world is the
+    provisioned slot pool, the mesh is the serving membership, and a
+    join/leave is a new mesh over a different process subset (no
+    runtime re-initialization).
 
     Defaults to dp=1 for a process-spanning mesh: the query batch is
     replicated to every process anyway (SPMD), so the scaling
@@ -105,6 +118,13 @@ def make_global_mesh(
     """
     if devices is None:
         devices = jax.devices()
+    if processes is not None:
+        allowed = {int(p) for p in processes}
+        devices = [d for d in devices if d.process_index in allowed]
+        if not devices:
+            raise ValueError(
+                f"no devices belong to member processes {sorted(allowed)}"
+            )
     devices = sorted(devices, key=lambda d: (d.process_index, d.id))
     n = len(devices)
     if dp is None and sp is None:
@@ -115,7 +135,37 @@ def make_global_mesh(
         sp = n // dp
     if dp * sp != n:
         raise ValueError(f"dp*sp = {dp}*{sp} != n_devices = {n}")
-    arr = np.asarray(devices, dtype=object).reshape(dp, sp)
+    # column-blocked placement: walk processes in order, each filling
+    # its local-count/dp whole columns top to bottom
+    local_counts: Dict[int, int] = {}
+    for d in devices:
+        local_counts[d.process_index] = (
+            local_counts.get(d.process_index, 0) + 1
+        )
+    if len(local_counts) > 1:
+        bad = {
+            p: k for p, k in local_counts.items() if k % dp != 0
+        }
+        if bad:
+            raise ValueError(
+                f"dp={dp} does not divide local device counts {bad}: "
+                "per-host sp columns would be non-contiguous/shared "
+                "(choose dp=1 or a dp dividing every host's devices)"
+            )
+    arr = np.empty((dp, sp), dtype=object)
+    col = 0
+    for p in sorted(local_counts):
+        pdevs = [d for d in devices if d.process_index == p]
+        k = len(pdevs) // dp if len(local_counts) > 1 else None
+        if k is None:
+            # single process: plain row-major (any layout is local)
+            arr = np.asarray(devices, dtype=object).reshape(dp, sp)
+            col = sp
+            break
+        block = np.asarray(pdevs, dtype=object).reshape(dp, k)
+        arr[:, col : col + k] = block
+        col += k
+    assert col == sp
     mesh = Mesh(arr, ("dp", "sp"))
     owner = np.asarray(
         [[d.process_index for d in row] for row in arr], dtype=np.int64
@@ -126,6 +176,19 @@ def make_global_mesh(
             {j for j in range(sp) if (owner[:, j] == p).any()}
         )
         sp_by_process[p] = tuple(cols)
+    # the invariant the docstring promises: every column has ONE owner
+    # and every process's columns form one contiguous run
+    for p, cols in sp_by_process.items():
+        if list(cols) != list(range(cols[0], cols[-1] + 1)):
+            raise AssertionError(
+                f"process {p} sp columns non-contiguous: {cols}"
+            )
+    if len(local_counts) > 1:
+        for j in range(sp):
+            if len({int(x) for x in owner[:, j]}) != 1:
+                raise AssertionError(
+                    f"sp column {j} spans processes: {owner[:, j]}"
+                )
     try:
         proc_idx = jax.process_index()
     except Exception:  # pragma: no cover — pre-distributed-init

@@ -88,6 +88,8 @@ MULTIHOST_METRICS = (
     "dss_multihost_refresh_bytes",
     "dss_multihost_commands",
     "dss_multihost_local_only",
+    "dss_multihost_members",
+    "dss_multihost_is_member",
 )
 
 
@@ -408,12 +410,28 @@ class MultihostReplica:
         warm_batches=(1,),
         tier_ratio: Optional[float] = None,
         cut_timeout_s: float = 30.0,
+        members: Optional[tuple] = None,
     ):
         from dss_tpu.parallel.replica import ShardedReplica
 
         self.runtime = runtime
         self.placement = placement
         self._cut_timeout_s = cut_timeout_s
+        # elastic membership: the jax.distributed world is the
+        # provisioned slot pool; `members` is the subset of processes
+        # whose devices form the SERVING mesh.  A standby process
+        # (world member, not mesh member) tails the log in lockstep —
+        # that IS its snapshot+tail catch-up — and the next fold after
+        # a reform cuts it into the boundary map.
+        self._members = (
+            tuple(sorted(set(members)))
+            if members
+            else tuple(range(runtime.num_processes))
+        )
+        if 0 not in self._members:
+            raise ValueError("process 0 (the leader) must be a member")
+        self._pending_members: Optional[tuple] = None
+        self._dp = placement.dp
         self._inner = ShardedReplica(
             placement.mesh,
             wal_path=wal_path,
@@ -443,6 +461,16 @@ class MultihostReplica:
     def mesh(self):
         return self._inner.mesh
 
+    @property
+    def members(self) -> tuple:
+        return self._members
+
+    @property
+    def is_member(self) -> bool:
+        """Is THIS process part of the serving mesh (vs a standby
+        slot tailing the log awaiting a join)?"""
+        return self.runtime.process_id in self._members
+
     def _account_refresh_bytes(self) -> None:
         self.runtime.refresh_bytes = self._inner.device_bytes_built
 
@@ -463,6 +491,9 @@ class MultihostReplica:
         inner = self._inner
         local = jax.local_devices()
         inner.mesh = make_mesh(len(local), devices=local)
+        # the old mesh's sp count is gone with the peers: the boundary
+        # map (n_sp-1 split points) no longer applies
+        inner.reset_boundaries()
         with inner._mu:
             for c in inner._records:
                 inner._base[c] = set()
@@ -498,9 +529,76 @@ class MultihostReplica:
         with self._op_mu:
             self._broadcast(kind, **scalars)
 
+    def set_members(self, members) -> None:
+        """Request a membership change (join and/or leave): the NEXT
+        leader sync broadcasts a reform with the fold cut, every
+        member re-homes on a mesh over the new member set, and the
+        incoming process's lockstep log tail becomes its serving
+        state.  Leader-side API."""
+        m = tuple(sorted(set(int(p) for p in members)))
+        if 0 not in m:
+            raise ValueError("process 0 (the leader) must be a member")
+        bad = [p for p in m if p >= self.runtime.num_processes]
+        if bad:
+            raise ValueError(
+                f"members {bad} outside the provisioned world "
+                f"(num_processes={self.runtime.num_processes})"
+            )
+        self._pending_members = m
+
+    def _apply_reform(self, members: tuple) -> None:
+        """Re-home the replica on a mesh over `members` (runs on every
+        process, leader and follower alike, at the broadcast cut).
+        Members rebuild every class major on the new mesh (each host
+        materializes only its addressable shard rows); a process that
+        left drops its device state and keeps tailing as standby."""
+        from dss_tpu.parallel.mesh import make_global_mesh
+
+        inner = self._inner
+        self._members = tuple(members)
+        if self.is_member:
+            placement = make_global_mesh(
+                dp=self._dp, processes=self._members
+            )
+            self.placement = placement
+            inner.mesh = placement.mesh
+        inner.reset_boundaries()
+        with inner._mu:
+            for c in inner._records:
+                inner._base[c] = set()
+                inner._delta[c] = {}
+                inner._shadow[c] = set()
+                inner._dirty[c] = True
+            inner._snapshots = {c: None for c in inner._snapshots}
+        if self.is_member:
+            inner.refresh(plan=False)
+            self._account_refresh_bytes()
+            log.info(
+                "mesh reformed: members %s, placement %s",
+                self._members, self.placement.describe(),
+            )
+        else:
+            log.info(
+                "left the serving mesh (members now %s); tailing as "
+                "standby", self._members,
+            )
+
+    def _boundary_payload(self) -> dict:
+        inner = self._inner
+        return {
+            "boundaries": (
+                None
+                if inner.boundaries is None
+                else [int(x) for x in inner.boundaries]
+            ),
+            "bgen": inner.boundary_gen,
+        }
+
     def sync(self) -> None:
         """Leader pacing: poll the tail to its current end, broadcast
-        the exact cut, fold in lockstep.  Degraded: plain local sync."""
+        the exact cut (+ the rebalanced boundary map), fold in
+        lockstep.  A pending membership change reforms the mesh at
+        this fold boundary first.  Degraded: plain local sync."""
         with self._op_mu:
             inner = self._inner
             if self._local_only:
@@ -514,6 +612,29 @@ class MultihostReplica:
                     "followers are paced by run_follower(), not sync()"
                 )
             inner.poll_once()
+            if self._pending_members is not None:
+                m, self._pending_members = self._pending_members, None
+                if m != self._members:
+                    cut = inner.tail_position()
+                    try:
+                        self._broadcast(
+                            "reform",
+                            cut=cut,
+                            fp=inner.state_fingerprint(),
+                            members=list(m),
+                        )
+                        self._apply_reform(m)
+                    except MultihostDegradedError:
+                        raise
+                    except Exception as e:  # noqa: BLE001
+                        if self._maybe_degrade_on(e):
+                            return
+                        raise
+                    return
+            # the rebalance decision is leader-only (followers apply
+            # the broadcast boundaries verbatim); a boundary move
+            # marks every class dirty, so the fold below ships it
+            inner.plan_rebalance()
             with inner._mu:
                 dirty = any(inner._dirty.values()) or any(
                     s is None for s in inner._snapshots.values()
@@ -526,8 +647,9 @@ class MultihostReplica:
                     "refresh",
                     cut=cut,
                     fp=inner.state_fingerprint(),
+                    **self._boundary_payload(),
                 )
-                inner.refresh()
+                inner.refresh(plan=False)
             except MultihostDegradedError:
                 raise
             except Exception as e:  # noqa: BLE001 — collective failure
@@ -593,9 +715,15 @@ class MultihostReplica:
                     },
                     cls=cls,
                 )
-                return inner.query_padded(
+                rows = inner.query_padded(
                     cls, qkeys, alo, ahi, ts, te, now_arr
                 )
+                # leader-side load accounting (the planning input):
+                # followers never record — the leader's map is the one
+                # the broadcast boundaries come from
+                for i, row in enumerate(rows):
+                    inner.load.record(keys_list[i], len(row))
+                return rows
             except Exception as e:  # noqa: BLE001 — collective failure
                 if self._maybe_degrade_on(e):
                     return inner.query_batch_host(
@@ -689,18 +817,37 @@ class MultihostReplica:
                         return
                     if kind == "refresh":
                         self._follower_refresh(
-                            head["cut"], head.get("fp")
+                            head["cut"],
+                            head.get("fp"),
+                            boundaries=head.get("boundaries"),
+                            bgen=head.get("bgen", 0),
                         )
+                    elif kind == "reform":
+                        # membership change at the broadcast cut: tail
+                        # there first (the joiner's snapshot+tail
+                        # catch-up ends exactly at the cut), verify
+                        # state, then re-home on the new member mesh
+                        self._follower_tail_to(
+                            head["cut"],
+                            head.get("fp"),
+                            # a reform rebuilds major from records on
+                            # every process: tier bookkeeping (which a
+                            # joining standby never accumulated) does
+                            # not participate in the new shapes
+                            content_only=True,
+                        )
+                        self._apply_reform(tuple(head["members"]))
                     elif kind == "query":
-                        inner.query_padded(
-                            head["cls"],
-                            arrays["qkeys"],
-                            arrays["alt_lo"],
-                            arrays["alt_hi"],
-                            arrays["t_start"],
-                            arrays["t_end"],
-                            arrays["now"],
-                        )
+                        if self.is_member:
+                            inner.query_padded(
+                                head["cls"],
+                                arrays["qkeys"],
+                                arrays["alt_lo"],
+                                arrays["alt_hi"],
+                                arrays["t_start"],
+                                arrays["t_end"],
+                                arrays["now"],
+                            )
                     elif kind in self.extra_commands:
                         self.extra_commands[kind](head)
             except MultihostDegradedError as e:
@@ -712,14 +859,37 @@ class MultihostReplica:
                 )
                 raise MultihostDegradedError(str(e)) from e
 
-    def _follower_refresh(self, cut, leader_fp) -> None:
-        """Tail to EXACTLY the leader's cut, then fold: both processes
-        fold the identical record prefix, so tier decisions, array
-        shapes, and the resulting collective sequence all match.  The
-        leader's state fingerprint is checked BEFORE any collective is
-        issued — a divergent fold (e.g. a region snapshot-reset that
-        jumped past the cut on one side) must degrade, never wedge the
-        mesh with mismatched shapes."""
+    @staticmethod
+    def _fp_content(fp: Optional[dict]) -> Optional[dict]:
+        """The log-content half of a state fingerprint: applied counts
+        and per-class record counts, WITHOUT the tier bookkeeping.  A
+        standby process tails the log but never folds, so its
+        delta/base/shadow split legitimately differs from the members'
+        — yet its RECORDS must match exactly, and a reform rebuilds
+        every class major from records alone."""
+        if fp is None:
+            return None
+        return {
+            "applied": fp.get("applied"),
+            "apply_errors": fp.get("apply_errors"),
+            "classes": {
+                c: v[0] for c, v in fp.get("classes", {}).items()
+            },
+        }
+
+    def _follower_tail_to(
+        self, cut, leader_fp, content_only: bool = False
+    ) -> None:
+        """Tail to EXACTLY the leader's cut and verify state: both
+        processes then hold the identical record prefix, so tier
+        decisions, array shapes, and the resulting collective sequence
+        all match.  The leader's state fingerprint is checked BEFORE
+        any collective is issued — a divergent fold (e.g. a region
+        snapshot-reset that jumped past the cut on one side) must
+        degrade, never wedge the mesh with mismatched shapes.
+        `content_only` compares records, not tier bookkeeping (standby
+        catch-up checks and reforms, where every class rebuilds major
+        from the record map)."""
         inner = self._inner
         deadline = time.monotonic() + self._cut_timeout_s
         while inner.tail_position() < cut:
@@ -738,12 +908,35 @@ class MultihostReplica:
                 f"{inner.tail_position()}): lockstep broken"
             )
         fp = inner.state_fingerprint()
+        if content_only:
+            fp, leader_fp = (
+                self._fp_content(fp), self._fp_content(leader_fp)
+            )
         if leader_fp is not None and fp != leader_fp:
             raise MultihostDegradedError(
                 f"replica state diverged from leader at cut {cut}: "
                 f"{fp} != {leader_fp}"
             )
-        inner.refresh()
+
+    def _follower_refresh(
+        self, cut, leader_fp, boundaries=None, bgen: int = 0
+    ) -> None:
+        """Tail to the cut, adopt the leader's boundary map verbatim
+        (the load measurement lives on the leader — followers must
+        never plan their own split or the mesh would build mismatched
+        shard rows), then fold.  A standby (non-member) process stops
+        after the tail: staying caught up IS its snapshot+tail
+        readiness for a future join — its record map must match the
+        leader's, but its never-folded tier bookkeeping legitimately
+        differs, so only log content is compared."""
+        self._follower_tail_to(
+            cut, leader_fp, content_only=not self.is_member
+        )
+        if not self.is_member:
+            return
+        inner = self._inner
+        inner.apply_boundaries(boundaries, bgen)
+        inner.refresh(plan=False)
         self._account_refresh_bytes()
 
     # -- lifecycle / passthrough ----------------------------------------------
@@ -785,6 +978,8 @@ class MultihostReplica:
     def fresh(self, bound_s: Optional[float] = None) -> bool:
         if self._local_only:
             return False  # degraded: bounded-staleness contract broken
+        if not self.is_member:
+            return False  # standby slot: no mesh state to serve from
         return self._inner.fresh(bound_s)
 
     def staleness_s(self) -> float:
@@ -793,8 +988,15 @@ class MultihostReplica:
     def poll_once(self, limit=None) -> int:
         return self._inner.poll_once(limit=limit)
 
+    def use_load(self, load) -> None:
+        """Adopt the store's shared RangeLoad (leader serving path);
+        see ShardedReplica.use_load."""
+        self._inner.use_load(load)
+
     def stats(self) -> dict:
         out = self._inner.stats()
         out.update(self.runtime.stats())
         out["dss_multihost_local_only"] = int(self._local_only)
+        out["dss_multihost_members"] = len(self._members)
+        out["dss_multihost_is_member"] = int(self.is_member)
         return out

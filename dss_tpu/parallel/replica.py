@@ -48,9 +48,41 @@ from dss_tpu.dar import tiers as tiersmod
 from dss_tpu.dar.oracle import Record
 from dss_tpu.geo import s2cell
 from dss_tpu.ops.conflict import NO_TIME_HI, NO_TIME_LO
-from dss_tpu.parallel.sharded import ShardedDar
+from dss_tpu.parallel.sharded import (
+    ShardedDar,
+    imbalance_factor,
+    shard_of_keys,
+    weighted_boundaries,
+)
 
 log = logging.getLogger("dss.replica")
+
+
+def env_rebalance_ratio() -> float:
+    """DSS_SHARD_REBALANCE_RATIO: the hysteresis threshold — boundary
+    moves happen only when predicted per-shard load imbalance
+    (max/mean) exceeds this.  <= 1 disables rebalancing (static
+    equal-count placement, the pre-r07 behavior)."""
+    try:
+        return float(os.environ.get("DSS_SHARD_REBALANCE_RATIO", 1.5))
+    except ValueError:
+        raise ValueError(
+            "DSS_SHARD_REBALANCE_RATIO="
+            f"{os.environ['DSS_SHARD_REBALANCE_RATIO']!r} is not a float"
+        )
+
+
+def env_move_interval_s() -> float:
+    """DSS_SHARD_MOVE_INTERVAL_S: the move-rate cap — at most one
+    boundary move per interval, so rebalance-forced major folds can
+    never starve serving."""
+    try:
+        return float(os.environ.get("DSS_SHARD_MOVE_INTERVAL_S", 5.0))
+    except ValueError:
+        raise ValueError(
+            "DSS_SHARD_MOVE_INTERVAL_S="
+            f"{os.environ['DSS_SHARD_MOVE_INTERVAL_S']!r} is not a float"
+        )
 
 # entity classes the replica serves (replica class name -> WAL prefix)
 CLASSES = ("ops", "isas", "rid_subs", "scd_subs")
@@ -258,17 +290,60 @@ class ShardedReplica:
         wal_path: Optional[str] = None,
         region_client=None,
         max_results: int = 512,
+        shard_results: Optional[int] = None,
         warm_batches=(1,),
         tier_ratio: Optional[float] = None,  # None = DSS_TIER_RATIO env
+        load: Optional[tiersmod.RangeLoad] = None,
+        rebalance_ratio: Optional[float] = None,  # None = env
+        move_interval_s: Optional[float] = None,  # None = env
     ):
         if (wal_path is None) == (region_client is None):
             raise ValueError("exactly one of wal_path / region_client")
         self.mesh = mesh
         self.max_results = max_results
+        self.shard_results = shard_results
         self._tier_ratio = (
             tiersmod.env_policy().ratio
             if tier_ratio is None
             else float(tier_ratio)
+        )
+        # -- skew-aware placement state ---------------------------------------
+        # measured query load per key range; server mode swaps in the
+        # store's shared instance (use_load) so coalescer-served
+        # traffic drives the same map the splitter consumes
+        self.load = load if load is not None else tiersmod.RangeLoad()
+        self.rebalance_ratio = (
+            env_rebalance_ratio()
+            if rebalance_ratio is None
+            else float(rebalance_ratio)
+        )
+        self.move_interval_s = (
+            env_move_interval_s()
+            if move_interval_s is None
+            else float(move_interval_s)
+        )
+        # the published boundary map (None = equal-count split) and
+        # its generation — the currency a multihost leader broadcasts
+        # with the fold cut so every process splits identically
+        self.boundaries: Optional[np.ndarray] = None
+        # boundary_gen is the LOCKSTEP currency (compared against the
+        # leader's broadcast bgen; reset to 0 by a reform on every
+        # process so joiners and incumbents agree); boundary_moves is
+        # the monotonic operator gauge and never resets
+        self.boundary_gen = 0
+        self.boundary_moves = 0
+        self.moved_bytes = 0
+        self._imbalance = 1.0  # predicted under current boundaries
+        # -inf so the FIRST justified move is never rate-capped (a
+        # fresh boot's monotonic clock can be younger than the cap)
+        self._last_move = float("-inf")
+        self._last_decay = float("-inf")
+        self._last_plan = float("-inf")
+        self._force_major: Dict[str, bool] = {c: False for c in CLASSES}
+        # per-shard measured hits absorbed from retired dars (the live
+        # dars' counters reset on every rebuild swap)
+        self._shard_hits_total = np.zeros(
+            mesh.shape["sp"], np.int64
         )
         # batch sizes to warm per rebuild: each maps to a pow2 jit
         # bucket; mesh-offload consumers add their min_batch so the
@@ -481,11 +556,186 @@ class ShardedReplica:
                     )
             return len(recs)
 
-    def refresh(self) -> bool:
+    # -- skew-aware placement -------------------------------------------------
+
+    def use_load(self, load: tiersmod.RangeLoad) -> None:
+        """Adopt a shared RangeLoad (the store's, in server mode) so
+        coalescer-served traffic and replica-served traffic accumulate
+        into ONE map."""
+        self.load = load
+
+    def note_query_load(self, keys, work: float) -> None:
+        self.load.record(keys, work)
+
+    def _all_posting_keys(self) -> np.ndarray:
+        """Sorted concatenation of every class's record keys — the
+        postings population the splitter plans over (classes share one
+        S2 key space and one boundary map)."""
+        with self._mu:
+            parts = [
+                r.keys
+                for recs in self._records.values()
+                for r in recs.values()
+            ]
+        if not parts:
+            return np.zeros(0, np.int32)
+        return np.sort(np.concatenate(parts).astype(np.int32))
+
+    def _predicted_shard_loads(
+        self, keys: np.ndarray, w: np.ndarray, boundaries
+    ) -> np.ndarray:
+        n_sp = self.mesh.shape["sp"]
+        loads = np.zeros(n_sp, np.float64)
+        if not len(keys):
+            return loads
+        if boundaries is None:
+            # equal-count split: contiguous index ranges
+            ps = max((len(keys) + n_sp - 1) // n_sp, 8)
+            for i in range(n_sp):
+                loads[i] = w[i * ps : (i + 1) * ps].sum()
+        else:
+            np.add.at(loads, shard_of_keys(keys, boundaries, n_sp), w)
+        return loads
+
+    def plan_rebalance(self, now: Optional[float] = None) -> bool:
+        """Evaluate the measured load map against the current split
+        and move the boundaries when the hot spot justifies it.
+        Leader-side only (multihost followers APPLY broadcast
+        boundaries, never plan).  -> True when boundaries moved.
+
+        Hysteresis: no move unless predicted imbalance (max/mean
+        per-shard load) exceeds `rebalance_ratio`.  Move-rate cap: at
+        most one move per `move_interval_s`.  A move forces a major
+        rebuild of every class at the NEXT fold — the cost an operator
+        trades for spreading the hot range."""
+        t = time.monotonic() if now is None else now
+        # the whole planning scan (concat+sort of every class's keys)
+        # is rate-limited to the move cadence: a 0.5s refresh loop
+        # must not pay an O(total postings) sort per tick just to
+        # re-learn that the split is still balanced
+        if t - max(self._last_plan, self._last_move) < self.move_interval_s:
+            return False
+        self._last_plan = t
+        # decay runs even with rebalancing disabled: the load map (and
+        # its gauges) must not grow without bound under a static split
+        if t - self._last_decay >= self.move_interval_s:
+            self.load.decay()
+            self._last_decay = t
+        if self.rebalance_ratio <= 1.0:
+            return False
+        if self.load.total() <= 0:
+            self._imbalance = 1.0
+            return False
+        keys = self._all_posting_keys()
+        if not len(keys):
+            self._imbalance = 1.0
+            return False
+        w = self.load.weights_for(keys)
+        cur = self._predicted_shard_loads(keys, w, self.boundaries)
+        self._imbalance = imbalance_factor(cur)
+        if self._imbalance <= self.rebalance_ratio:
+            return False
+        n_sp = self.mesh.shape["sp"]
+        new_b = weighted_boundaries(keys, w, n_sp)
+        if new_b is None or (
+            self.boundaries is not None
+            and np.array_equal(new_b, self.boundaries)
+        ):
+            return False
+        # move accounting: postings whose shard assignment changed
+        # (key+slot int32 pairs — the per-host re-ship upper bound)
+        old_shard = (
+            shard_of_keys(keys, self.boundaries, n_sp)
+            if self.boundaries is not None
+            else self._equal_count_shards(len(keys), n_sp)
+        )
+        moved = int(
+            (old_shard != shard_of_keys(keys, new_b, n_sp)).sum()
+        )
+        self.moved_bytes += moved * 8
+        self.boundaries = new_b
+        self.boundary_gen += 1
+        self.boundary_moves += 1
+        self._last_move = t
+        with self._mu:
+            for c in CLASSES:
+                self._force_major[c] = True
+                self._dirty[c] = True
+        log.info(
+            "shard rebalance #%d: imbalance %.2f > %.2f, %d postings "
+            "move (%d B)",
+            self.boundary_moves, self._imbalance, self.rebalance_ratio,
+            moved, moved * 8,
+        )
+        return True
+
+    @staticmethod
+    def _equal_count_shards(n: int, n_sp: int) -> np.ndarray:
+        ps = max((n + n_sp - 1) // n_sp, 8)
+        return np.minimum(
+            np.arange(n, dtype=np.int64) // ps, n_sp - 1
+        ).astype(np.int32)
+
+    def apply_boundaries(self, boundaries, bgen: int) -> None:
+        """Adopt a leader-broadcast boundary map (multihost follower
+        path): the split is applied verbatim — no local planning — so
+        every process builds identical shard rows for the identical
+        record prefix."""
+        if bgen == self.boundary_gen:
+            return
+        self.boundaries = (
+            None if boundaries is None
+            else np.asarray(boundaries, np.int32)
+        )
+        self.boundary_gen = int(bgen)
+        self.boundary_moves += 1
+        with self._mu:
+            for c in CLASSES:
+                self._force_major[c] = True
+                self._dirty[c] = True
+
+    def reset_boundaries(self) -> None:
+        """Drop to the equal-count cold-start split (mesh shape
+        changed: degrade re-home or membership reform — the old n_sp's
+        boundary map no longer applies)."""
+        self.boundaries = None
+        # lockstep currency resets with the map (a reform runs this on
+        # EVERY process — incumbents and joiners then agree on bgen 0,
+        # so the next broadcast bgen drives identical force-major
+        # decisions everywhere); boundary_moves (the gauge) keeps
+        # counting
+        self.boundary_gen = 0
+        self._shard_hits_total = np.zeros(
+            self.mesh.shape["sp"], np.int64
+        )
+
+    def measured_shard_loads(self) -> np.ndarray:
+        """Per-shard unique-hit work measured by the sharded kernels:
+        retired-dar totals plus the live dars' counters."""
+        n_sp = self.mesh.shape["sp"]
+        out = np.zeros(n_sp, np.int64)
+        tot = self._shard_hits_total
+        out[: min(len(tot), n_sp)] += tot[: min(len(tot), n_sp)]
+        for snap in self._snapshots.values():
+            if snap is None:
+                continue
+            for dar in (snap.base, snap.delta):
+                if dar is not None and dar.n_sp == n_sp:
+                    out += dar.shard_hits
+        return out
+
+    def refresh(self, *, plan: bool = True) -> bool:
         """Fold ingested records into fresh ShardedDars (one per dirty
         class) and swap them in (atomic per class for readers).
-        -> True if any new snapshot was published."""
+        -> True if any new snapshot was published.
+
+        `plan` runs the rebalance decision first (single-process
+        serving); a multihost leader plans and BROADCASTS before
+        folding and passes plan=False here, followers always apply
+        broadcast boundaries instead."""
         with self._refresh_mu:
+            if plan:
+                self.plan_rebalance()
             published = False
             for cls in CLASSES:
                 published |= self._refresh_class(cls)
@@ -505,15 +755,24 @@ class ShardedReplica:
             major = (
                 prev is None
                 or not self._base[cls]
+                or self._force_major[cls]
                 or self._tier_ratio <= 0
                 or churn > self._tier_ratio * max(len(self._base[cls]), 1)
             )
+            bounds = self.boundaries
             if major:
-                # full repack: fresh base tier, tombstones GC'd
+                # full repack: fresh base tier, tombstones GC'd (and,
+                # after a boundary move, the rebuild that re-homes
+                # every shard row under the new key ranges)
+                self._force_major[cls] = False
                 recs = list(self._records[cls].values())
                 base = (
                     ShardedDar(
-                        recs, self.mesh, max_results=self.max_results
+                        recs,
+                        self.mesh,
+                        max_results=self.max_results,
+                        shard_results=self.shard_results,
+                        boundaries=bounds,
                     )
                     if recs
                     else None
@@ -535,7 +794,11 @@ class ShardedReplica:
                 drecs = list(self._delta[cls].values())
                 delta = (
                     ShardedDar(
-                        drecs, self.mesh, max_results=self.max_results
+                        drecs,
+                        self.mesh,
+                        max_results=self.max_results,
+                        shard_results=self.shard_results,
+                        boundaries=bounds,
                     )
                     if drecs
                     else None
@@ -578,6 +841,18 @@ class ShardedReplica:
                     pass
             self._warm_ms_total += (time.perf_counter() - t_warm) * 1000
         with self._mu:
+            old = self._snapshots[cls]
+            if old is not None:
+                # retiring dars take their measured per-shard work
+                # with them; absorb it so the load heat map survives
+                # rebuild swaps
+                retired = (
+                    (old.base, old.delta) if major else (old.delta,)
+                )
+                n_sp = len(self._shard_hits_total)
+                for dar in retired:
+                    if dar is not None and dar.n_sp == n_sp:
+                        self._shard_hits_total += dar.shard_hits
             self._snapshots[cls] = snap
             self._rebuilds += 1
             if built is not None:
@@ -740,7 +1015,13 @@ class ShardedReplica:
         qkeys, alo, ahi, ts, te, now_arr = self.pad_query_batch(
             keys_list, alt_lo, alt_hi, t_start, t_end, now=now
         )
-        return self.query_padded(cls, qkeys, alo, ahi, ts, te, now_arr)
+        rows = self.query_padded(cls, qkeys, alo, ahi, ts, te, now_arr)
+        # serving-entry load accounting: this query's covering stamps
+        # its key-range buckets with its measured candidate work (the
+        # input the skew-aware splitter plans from)
+        for i, row in enumerate(rows):
+            self.load.record(keys_list[i], len(row))
+        return rows
 
     def query_padded(
         self,
@@ -820,6 +1101,24 @@ class ShardedReplica:
             )
         return out
 
+    def shard_stats(self) -> dict:
+        """The skew-aware placement gauge family (satellite of the
+        load-weighted sharding work; flows into /metrics and the
+        Grafana heat panel).  dss_shard_load is a per-shard vector
+        (rendered as a labeled gauge); the rest are scalars."""
+        loads = self.measured_shard_loads()
+        return {
+            "dss_shard_load": {
+                str(i): float(v) for i, v in enumerate(loads)
+            },
+            "dss_shard_imbalance_factor": round(self._imbalance, 4),
+            "dss_shard_boundary_moves": self.boundary_moves,
+            "dss_shard_moved_bytes": self.moved_bytes,
+            "dss_shard_members": len(
+                {d.process_index for d in self.mesh.devices.flat}
+            ),
+        }
+
     def stats(self) -> dict:
         out = {
             "replica_applied_records": self._applied_records,
@@ -835,6 +1134,7 @@ class ShardedReplica:
                 else round(self.staleness_s(), 3)
             ),
         }
+        out.update(self.shard_stats())
         for cls in CLASSES:
             snap = self._snapshots[cls]
             out[f"replica_{cls}_records"] = len(self._records[cls])

@@ -22,6 +22,7 @@ random access to attributes of slots its postings name.
 
 from __future__ import annotations
 
+import threading
 from functools import partial
 from typing import List, Optional, Tuple
 
@@ -68,27 +69,176 @@ def shard_postings(
     post_ent: np.ndarray,
     n_sp: int,
     sentinel_slot: int,
+    boundaries: Optional[np.ndarray] = None,
 ) -> Tuple[np.ndarray, np.ndarray]:
-    """Split sorted postings into n_sp equal contiguous ranges.
+    """Split sorted postings into n_sp contiguous ranges.
 
     Returns ([n_sp, Ps] keys, [n_sp, Ps] slots), each row sorted, padded
-    with INT32_MAX / sentinel.  Splitting by postings *count* (not key
-    range) balances load under skewed cell occupancy; contiguity keeps
-    each row sorted so per-shard searchsorted still works.
+    with INT32_MAX / sentinel.  Without `boundaries` the split is by
+    equal postings *count* — balanced by storage, the cold-start
+    fallback.  With `boundaries` (n_sp-1 sorted int32 DAR-key split
+    points, usually from `weighted_boundaries`) shard i takes the key
+    range [boundaries[i-1], boundaries[i]) — the load-weighted
+    placement the rebalancer broadcasts, applicable to ANY postings
+    array over the same key space (base and delta tiers share one
+    boundary map).  Contiguity keeps each row sorted so per-shard
+    searchsorted still works.
     """
     live = post_key != INT32_MAX
     pk = np.asarray(post_key)[live]
     pe = np.asarray(post_ent)[live]
     n = len(pk)
-    ps = max((n + n_sp - 1) // n_sp, 8)
+    if boundaries is None:
+        ps = max((n + n_sp - 1) // n_sp, 8)
+        lohi = [
+            (i * ps, min((i + 1) * ps, n)) if i * ps < n else (n, n)
+            for i in range(n_sp)
+        ]
+    else:
+        b = np.asarray(boundaries, np.int32)
+        if len(b) != n_sp - 1:
+            raise ValueError(
+                f"boundaries has {len(b)} split points for {n_sp} shards"
+            )
+        cuts = [0] + [int(c) for c in np.searchsorted(pk, b)] + [n]
+        lohi = [(cuts[i], cuts[i + 1]) for i in range(n_sp)]
+        ps = max(max((hi - lo) for lo, hi in lohi), 8)
     keys = np.full((n_sp, ps), INT32_MAX, np.int32)
     ents = np.full((n_sp, ps), sentinel_slot, np.int32)
-    for i in range(n_sp):
-        lo, hi = i * ps, min((i + 1) * ps, n)
-        if lo < n:
+    for i, (lo, hi) in enumerate(lohi):
+        if hi > lo:
             keys[i, : hi - lo] = pk[lo:hi]
             ents[i, : hi - lo] = pe[lo:hi]
     return keys, ents
+
+
+def weighted_boundaries(
+    post_key: np.ndarray,
+    weights: Optional[np.ndarray],
+    n_sp: int,
+) -> Optional[np.ndarray]:
+    """Key-space split points equalizing predicted *query work* per
+    shard (the searched-mapping step: placement driven by measured
+    cost, not storage count).
+
+    `weights` is per-posting measured load (RangeLoad.weights_for);
+    every posting additionally carries one unit of count baseline, so
+    zero measured load (cold start) reproduces the equal-count split
+    and cold ranges still spread by storage.  Returns n_sp-1 sorted
+    int32 DAR keys, or None when there is nothing to split.  Split
+    points snap to key values (a single key's postings never straddle
+    shards), so a single cell hotter than a whole shard ends up alone
+    in its shard — the best key-range placement can do.  Per-shard
+    posting counts are capped at 4x the equal-count mean (the device
+    postings array is rectangular, padded to the LARGEST shard — the
+    cap bounds that memory/refresh-traffic blowup at 4x; indivisible
+    single-key runs excepted).
+    """
+    pk = np.asarray(post_key, np.int32).ravel()
+    pk = pk[pk != INT32_MAX]
+    n = len(pk)
+    if n == 0 or n_sp <= 1:
+        return None
+    w = np.ones(n, np.float64)
+    if weights is not None:
+        lw = np.asarray(weights, np.float64).ravel()
+        tot = lw.sum()
+        if tot > 0:
+            # normalize measured load to the same mass as the count
+            # baseline, then let it dominate: a shard's predicted work
+            # is mostly its query load, tempered by storage so empty-
+            # load ranges still split by count
+            w += lw * (n / tot) * 8.0
+    # greedy fill at KEY-RUN granularity (a key's postings never
+    # straddle shards), re-targeting the remaining weight over the
+    # remaining shards after each cut — a single run heavier than a
+    # whole shard then gets (nearly) its own shard instead of
+    # collapsing every later boundary onto the same key, and the mass
+    # on either side of it still splits evenly
+    uk, starts = np.unique(pk, return_index=True)
+    run_w = np.add.reduceat(w, starts)
+    run_n = np.diff(np.append(starts, n))
+    # the device postings array is rectangular ([n_sp, max shard
+    # postings]): cap any one shard's posting COUNT at 4x the mean so
+    # a load-weighted split that packs cold mass densely can cost at
+    # most 4x the equal-count layout's device bytes, never unbounded
+    # (a single key run larger than the cap is indivisible and allowed
+    # through)
+    count_cap = max(4 * ((n + n_sp - 1) // n_sp), 8)
+    bounds: list = []
+    rem_w = float(run_w.sum())
+    rem_sh = n_sp
+    acc = 0.0
+    acc_n = 0
+    consumed = 0  # postings in already-closed shards
+
+    def fits_after_cut(extra: int) -> bool:
+        # a cut is only legal when the postings left over still fit in
+        # the remaining shards under the cap — otherwise an early cut
+        # would force some LATER shard (often the last) over it
+        return (n - (consumed + extra)) <= (rem_sh - 1) * count_cap
+
+    for i in range(len(uk)):
+        if len(bounds) == n_sp - 1:
+            break
+        target = rem_w / rem_sh
+        if (
+            acc > 0
+            and (
+                (run_w[i] >= target and acc + run_w[i] > 1.5 * target)
+                or acc_n + int(run_n[i]) > count_cap
+            )
+            and fits_after_cut(acc_n)
+        ):
+            # the next run would overfill the shard (by weight, or by
+            # the rectangular-padding count cap): cut BEFORE it so the
+            # accumulated cold mass isn't welded to the hot run
+            bounds.append(int(uk[i]))
+            consumed += acc_n
+            rem_w -= acc
+            rem_sh -= 1
+            acc = 0.0
+            acc_n = 0
+            if len(bounds) == n_sp - 1:
+                break
+            target = rem_w / rem_sh
+        acc += float(run_w[i])
+        acc_n += int(run_n[i])
+        if acc >= target and i + 1 < len(uk) and fits_after_cut(acc_n):
+            bounds.append(int(uk[i + 1]))
+            consumed += acc_n
+            rem_w -= acc
+            rem_sh -= 1
+            acc = 0.0
+            acc_n = 0
+    while len(bounds) < n_sp - 1:
+        # out of keys: remaining shards are empty (legal — duplicate
+        # boundaries yield zero-width ranges)
+        bounds.append(bounds[-1] if bounds else int(uk[-1]))
+    return np.asarray(bounds, np.int32)
+
+
+def shard_of_keys(
+    keys: np.ndarray, boundaries: Optional[np.ndarray], n_sp: int
+) -> np.ndarray:
+    """Shard index for each key under a boundary map (None = cannot be
+    answered without the postings array; used for move accounting and
+    predicted-load-per-shard summaries)."""
+    k = np.asarray(keys, np.int32).ravel()
+    if boundaries is None or not len(k):
+        return np.zeros(len(k), np.int32)
+    return np.searchsorted(
+        np.asarray(boundaries, np.int32), k, side="right"
+    ).astype(np.int32)
+
+
+def imbalance_factor(loads) -> float:
+    """max/mean over per-shard loads — 1.0 is perfectly balanced; the
+    rebalance trigger compares this against DSS_SHARD_REBALANCE_RATIO."""
+    arr = np.asarray(loads, np.float64).ravel()
+    if not len(arr) or arr.sum() <= 0:
+        return 1.0
+    return float(arr.max() / arr.mean())
 
 
 def put_global(mesh: Mesh, spec: P, arr: np.ndarray):
@@ -163,7 +313,9 @@ def sharded_conflict_query_batch(
     replicate_out: bool = False,
 ):
     """Batched sharded query.  Returns (slots [Q, max_results] padded
-    with INT32_MAX, overflowed [Q] bool).
+    with INT32_MAX, overflowed [Q] bool, shard_hits [n_sp] int32 —
+    per-shard unique candidate hits summed over the batch, the
+    measured per-shard work the skew-aware rebalancer consumes).
 
     replicate_out=True all_gathers the merged results over "dp" as
     well, so EVERY device (and therefore every process of a multi-host
@@ -187,6 +339,13 @@ def sharded_conflict_query_batch(
             with_owner=with_owner,
         )
         shard_ovf = n_uni > shard_results  # [Qloc]
+        # per-shard measured work: unique hits this shard contributed
+        # across its local query slice, summed over "dp" so every
+        # device (and host) holds the identical [n_sp] load vector
+        hits = jax.lax.psum(
+            jax.lax.all_gather(jnp.sum(n_uni).astype(jnp.int32), "sp"),
+            "dp",
+        )
         gathered = jax.lax.all_gather(slots_s, "sp")  # [n_sp, Qloc, sr]
         merged = jnp.moveaxis(gathered, 0, 1).reshape(slots_s.shape[0], -1)
 
@@ -204,11 +363,13 @@ def sharded_conflict_query_batch(
                 -1, out.shape[-1]
             )
             ovf = jax.lax.all_gather(ovf, "dp").reshape(-1)
-        return out, ovf
+        return out, ovf, hits
 
     qspec = P("dp")
     out_specs = (
-        (P(), P()) if replicate_out else (P("dp", None), P("dp"))
+        (P(), P(), P())
+        if replicate_out
+        else (P("dp", None), P("dp"), P())
     )
     return shard_map(
         step,
@@ -258,6 +419,7 @@ class ShardedDar:
         *,
         max_results: int = 512,
         shard_results: Optional[int] = None,
+        boundaries: Optional[np.ndarray] = None,
     ):
         self.mesh = mesh
         self.n_sp = mesh.shape["sp"]
@@ -269,11 +431,26 @@ class ShardedDar:
         self.shard_results = shard_results or max_results
         self.records = {slot: r for slot, r in enumerate(records)}
         self.overflow_fallbacks = 0  # host-scan fallbacks (observability)
+        # key-space split map this dar was built under (None = legacy
+        # equal-count); kept for move accounting across rebuilds
+        self.boundaries = (
+            None if boundaries is None
+            else np.asarray(boundaries, np.int32)
+        )
+        # measured per-shard unique-hit work, accumulated across
+        # query batches (the rebalancer's measured-imbalance input);
+        # locked — concurrent snapshot readers must not lose updates
+        self.shard_hits = np.zeros(self.n_sp, np.int64)
+        self._hits_mu = threading.Lock()
 
         packed = pack_records(records, pad_postings=False)
         self.cap = packed.base_cap
         skey, sent = shard_postings(
-            packed.post_key, packed.post_ent, self.n_sp, packed.capacity
+            packed.post_key,
+            packed.post_ent,
+            self.n_sp,
+            packed.capacity,
+            boundaries=self.boundaries,
         )
 
         # host->device bytes this snapshot materializes (refresh
@@ -385,7 +562,7 @@ class ShardedDar:
                 t_end=mk(P("dp"), np.asarray(t_end, np.int64)),
             )
             now_dev = mk(P("dp"), np.asarray(now_arr, np.int64))
-        slots, ovf = sharded_conflict_query_batch(
+        slots, ovf, shard_hits = sharded_conflict_query_batch(
             self.post_key,
             self.post_ent,
             self.ents,
@@ -399,6 +576,8 @@ class ShardedDar:
         )
         slots = np.asarray(slots)[:qn]
         ovf = np.asarray(ovf)[:qn]
+        with self._hits_mu:
+            self.shard_hits += np.asarray(shard_hits, np.int64)
         out = []
         for i in range(qn):
             if ovf[i]:

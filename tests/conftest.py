@@ -54,3 +54,11 @@ def keypair():
         serialization.PublicFormat.SubjectPublicKeyInfo,
     )
     return priv, pub
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running acceptance tests (tier-1 CI runs -m 'not "
+        "slow'; the dedicated CI jobs run them unfiltered)",
+    )

@@ -68,6 +68,15 @@ def _exported_metric_names() -> set:
             f"replica_{c}_overflow_fallbacks",
             f"replica_{c}_dirty",
         }
+    # skew-aware shard placement gauges (ShardedReplica.shard_stats;
+    # dss_shard_load renders as a labeled per-shard family)
+    names |= {
+        "dss_shard_load",
+        "dss_shard_imbalance_factor",
+        "dss_shard_boundary_moves",
+        "dss_shard_moved_bytes",
+        "dss_shard_members",
+    }
     # tpu-storage DAR gauges (memory backend exports fewer)
     tpu = DSSStore(storage="tpu", clock=Clock())
     names |= set(tpu.stats())
@@ -460,3 +469,58 @@ def test_native_freshness_is_content_based(tmp_path):
     # rebuild restores freshness
     assert _buildlib.build(str(d))
     assert _buildlib.so_fresh(str(d))
+
+
+def test_grafana_and_rules_cover_shard_placement():
+    """Skew-aware shard placement must stay observable: a per-shard
+    load heat panel plus imbalance/boundary-move/membership series,
+    and a warning rule on sustained imbalance above the rebalance
+    threshold (a hot spot the rebalancer is NOT shedding)."""
+    dash = json.load(
+        open(os.path.join(ROOT, "deploy/grafana/dss-dashboard.json"))
+    )
+    exprs = [
+        t["expr"]
+        for p in dash["panels"]
+        for t in p.get("targets", [])
+    ]
+    for needed in (
+        "dss_shard_load",
+        "dss_shard_imbalance_factor",
+        "dss_shard_boundary_moves",
+        "dss_shard_moved_bytes",
+        "dss_shard_members",
+    ):
+        assert any(needed in e for e in exprs), needed
+    rules = yaml.safe_load(
+        open(os.path.join(ROOT, "deploy/prometheus/rules.yaml"))
+    )
+    alerts = {
+        r.get("alert"): r["expr"]
+        for g in rules["groups"]
+        for r in g["rules"]
+    }
+    assert "DssShardHotspot" in alerts
+    assert "dss_shard_imbalance_factor" in alerts["DssShardHotspot"]
+    assert "DssShardRebalanceThrash" in alerts
+    assert (
+        "dss_shard_boundary_moves" in alerts["DssShardRebalanceThrash"]
+    )
+
+
+def test_shard_gauges_render_as_labeled_family():
+    """dss_shard_load is a per-shard labeled gauge family: the /metrics
+    exposition must carry one series per shard so the heat panel can
+    render without per-shard metric names."""
+    from dss_tpu.obs.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    reg.set_gauge_vec(
+        "dss_shard_load", "shard", {"0": 10.0, "1": 3.0}
+    )
+    reg.set_gauge("dss_shard_imbalance_factor", 1.54)
+    text = reg.render()
+    assert 'dss_shard_load{shard="0"} 10.0' in text
+    assert 'dss_shard_load{shard="1"} 3.0' in text
+    assert "# TYPE dss_shard_load gauge" in text
+    assert "dss_shard_imbalance_factor 1.54" in text

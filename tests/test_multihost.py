@@ -123,11 +123,11 @@ def test_replicated_output_query_path_bit_identical():
         t_end=np.full(q, NO_TIME_HI, np.int64),
     )
     now = np.zeros(q, np.int64)
-    base, base_ovf = sharded_conflict_query_batch(
+    base, base_ovf, base_hits = sharded_conflict_query_batch(
         dar.post_key, dar.post_ent, dar.ents, spec, now,
         mesh=mesh, cap=dar.cap, shard_results=64, max_results=64,
     )
-    repl, repl_ovf = sharded_conflict_query_batch(
+    repl, repl_ovf, repl_hits = sharded_conflict_query_batch(
         dar.post_key, dar.post_ent, dar.ents, spec, now,
         mesh=mesh, cap=dar.cap, shard_results=64, max_results=64,
         replicate_out=True,
@@ -137,6 +137,11 @@ def test_replicated_output_query_path_bit_identical():
         np.asarray(base_ovf), np.asarray(repl_ovf)
     )
     assert (np.asarray(base) != INT32_MAX).any()  # hits exist
+    # the per-shard measured-work vector is replicated and consistent
+    np.testing.assert_array_equal(
+        np.asarray(base_hits), np.asarray(repl_hits)
+    )
+    assert np.asarray(base_hits).sum() > 0
 
 
 def test_replica_query_refactor_equivalence(tmp_path):
@@ -216,6 +221,24 @@ def test_two_process_dryrun_bit_identical_and_degrades(tmp_path):
     assert verdict["ok"], verdict
     assert verdict["bit_identical"], verdict
     assert verdict["peerloss_ok"], verdict
+    # elasticity: forced hot-range boundary move (imbalance detected,
+    # boundaries move, imbalance recovers, answers unchanged), a third
+    # process joins the live two-member mesh via its lockstep
+    # snapshot+tail, then leaves again — bit-identical throughout
+    assert verdict["elastic_ok"], verdict
+    el = verdict["elastic"]
+    assert el["hotmove"]["boundary_moves"] >= 1
+    assert (
+        el["hotmove"]["imbalance_after"]
+        < el["hotmove"]["imbalance_before"]
+    )
+    assert el["hotmove"]["match"] and el["join"]["match"]
+    assert el["leave"]["match"]
+    assert el["join"]["members"] == [0, 1, 2]
+    # the joined mesh spans three hosts on contiguous sp columns
+    assert el["join"]["placement"] == {
+        "0": [0, 1], "1": [2, 3], "2": [4, 5]
+    }
     multi = verdict["multi"]
     assert multi["num_processes"] == 2
     # explicit host<->shard placement: each process owns a contiguous

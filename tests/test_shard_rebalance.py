@@ -1,0 +1,462 @@
+"""Skew-aware shard placement: the weighted splitter, the rebalance
+decision (hysteresis + move-rate cap), the contiguity-enforcing global
+mesh placement, and the differential fuzz — rebalanced vs static
+placement must answer bit-identically under interleaved writes, folds,
+major compactions, and a mid-sequence boundary move.
+
+Runs on the virtual 8-device CPU mesh (conftest.py)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from dss_tpu.dar.oracle import Record
+from dss_tpu.dar.tiers import RangeLoad
+from dss_tpu.ops.conflict import INT32_MAX
+from dss_tpu.parallel import make_mesh
+from dss_tpu.parallel.sharded import (
+    ShardedDar,
+    imbalance_factor,
+    shard_of_keys,
+    shard_postings,
+    weighted_boundaries,
+)
+
+
+def _postings(rng, n=2000, key_space=10_000):
+    pk = np.sort(rng.integers(0, key_space, n).astype(np.int32))
+    pe = rng.integers(0, n // 4, n).astype(np.int32)
+    return pk, pe
+
+
+# -- weighted splitter ---------------------------------------------------------
+
+
+def test_zero_weight_falls_back_to_equal_count():
+    """Cold start: no measured load => the split must be EXACTLY the
+    legacy equal-count split (same rows, same padding)."""
+    rng = np.random.default_rng(0)
+    pk, pe = _postings(rng)
+    legacy_k, legacy_e = shard_postings(pk, pe, 8, 9999)
+    b = weighted_boundaries(pk, np.zeros(len(pk)), 8)
+    wk, we = shard_postings(pk, pe, 8, 9999, boundaries=b)
+    # boundary split snaps to key values, so rows can differ by a few
+    # postings where duplicate keys straddle the count cut — but the
+    # per-shard counts must stay within one duplicate-run of equal
+    counts = [(wk[i] != INT32_MAX).sum() for i in range(8)]
+    assert sum(counts) == len(pk)
+    assert max(counts) - min(counts) <= 64  # dup-run tolerance
+    # and with no weights at all, the legacy path is untouched
+    assert legacy_k.shape[0] == 8
+    assert (np.sort(np.concatenate(
+        [legacy_k[i][legacy_k[i] != INT32_MAX] for i in range(8)]
+    )) == pk).all()
+
+
+def test_hot_range_spreads_and_cold_packs():
+    """A hot key range carrying nearly all measured load must spread
+    across multiple shards (raising its aggregate per-shard result
+    capacity), while cold mass packs densely."""
+    rng = np.random.default_rng(1)
+    pk, pe = _postings(rng)
+    load = RangeLoad(shift=4)
+    for _ in range(50):
+        load.record(np.arange(4000, 4400, dtype=np.int32), work=100)
+    w = load.weights_for(pk)
+    b = weighted_boundaries(pk, w, 8)
+    sh = shard_of_keys(pk, b, 8)
+    hot = (pk >= 4000) & (pk < 4400)
+    hot_shards = set(sh[hot].tolist())
+    assert len(hot_shards) >= 3, hot_shards
+    # per-shard weighted work is near-balanced after the split
+    loads = np.zeros(8)
+    np.add.at(loads, sh, w + 1.0)
+    assert imbalance_factor(loads) < 1.5
+
+
+def test_single_key_hotter_than_a_shard_isolates():
+    """One cell hotter than a whole shard cannot be split by key-range
+    placement — the best possible outcome is that it lands ALONE (or
+    nearly so) in its shard, and the splitter must deliver that."""
+    rng = np.random.default_rng(2)
+    pk = np.sort(
+        np.concatenate([
+            rng.integers(0, 10_000, 1500),
+            np.full(64, 5000),  # one massive cell
+        ]).astype(np.int32)
+    )
+    pe = rng.integers(0, 400, len(pk)).astype(np.int32)
+    load = RangeLoad(shift=0)  # bucket == key
+    for _ in range(50):
+        load.record(np.asarray([5000], np.int32), work=1000)
+    b = weighted_boundaries(pk, load.weights_for(pk), 8)
+    sh = shard_of_keys(pk, b, 8)
+    hot_shard = sh[pk == 5000]
+    assert (hot_shard == hot_shard[0]).all()  # never straddles
+    # the hot key's shard holds (almost) nothing else
+    others = (sh == hot_shard[0]) & (pk != 5000)
+    assert others.sum() <= len(pk) // 8
+
+
+def test_empty_shards_are_legal_and_correct():
+    """Duplicate split points (a hot range narrower than its shard
+    count) produce EMPTY shards; the kernel must still answer
+    correctly (empty rows contribute nothing)."""
+    recs = [
+        Record(
+            entity_id=f"e{i}",
+            keys=np.asarray([100 + i], np.int32),
+            alt_lo=0.0, alt_hi=100.0,
+            t_start=-(2**62), t_end=2**62, owner_id=0,
+        )
+        for i in range(4)
+    ]
+    mesh = make_mesh(8, dp=1, sp=8)
+    # 7 split points over 4 keys: several shards must stay empty
+    b = np.asarray([100, 101, 102, 103, 104, 104, 104], np.int32)
+    dar = ShardedDar(recs, mesh, max_results=16, boundaries=b)
+    out = dar.query_batch(
+        np.asarray([[100, 101, 102, 103]], np.int32),
+        np.asarray([-np.inf], np.float32),
+        np.asarray([np.inf], np.float32),
+        np.asarray([-(2**62)], np.int64),
+        np.asarray([2**62], np.int64),
+        now=0,
+    )
+    assert sorted(out[0]) == [0, 1, 2, 3]
+
+
+def test_boundary_split_matches_equal_count_answers():
+    """Any boundary map is a pure placement change: kernel answers
+    must be bit-identical to the equal-count split's."""
+    from dss_tpu.dar import oracle as om
+
+    rng = np.random.default_rng(3)
+    recs = []
+    for i in range(200):
+        keys = np.unique(rng.integers(0, 500, 5).astype(np.int32))
+        alo, ahi = sorted(rng.uniform(0, 3000, 2))
+        recs.append(Record(
+            entity_id=f"e{i}", keys=keys,
+            alt_lo=float(alo), alt_hi=float(ahi),
+            t_start=-(2**62), t_end=2**62,
+            owner_id=0,
+        ))
+    mesh = make_mesh(8, dp=1, sp=8)
+    static = ShardedDar(recs, mesh, max_results=256)
+    skewed = ShardedDar(
+        recs, mesh, max_results=256,
+        boundaries=np.asarray([20, 40, 60, 80, 120, 300, 400], np.int32),
+    )
+    q = 16
+    keys = np.sort(rng.integers(0, 500, (q, 8)).astype(np.int32), axis=1)
+    args = (
+        np.full(q, -np.inf, np.float32),
+        np.full(q, np.inf, np.float32),
+        np.full(q, -(2**62), np.int64),
+        np.full(q, 2**62, np.int64),
+    )
+    a = static.query_batch(keys, *args, now=0)
+    bq = skewed.query_batch(keys, *args, now=0)
+    assert a == bq
+    # and both match the exact oracle
+    for i in range(q):
+        want = sorted(om.search(
+            static.records, keys[i], None, None, None, None, 0
+        ))
+        assert sorted(a[i]) == want
+    # the kernel's measured per-shard work reflects the split
+    assert skewed.shard_hits.sum() == static.shard_hits.sum()
+
+
+# -- global mesh contiguity ----------------------------------------------------
+
+
+class _FakeDev:
+    def __init__(self, pid, did):
+        self.process_index = pid
+        self.id = did
+
+    def __repr__(self):
+        return f"d{self.process_index}.{self.id}"
+
+
+def _fake_world(counts):
+    return [
+        _FakeDev(p, p * 100 + i)
+        for p, k in enumerate(counts)
+        for i in range(k)
+    ]
+
+
+def test_global_mesh_contiguous_columns_dp2():
+    """dp=2 over two 4-device hosts: the old row-major reshape gave
+    every sp column BOTH processes (non-contiguous per-host ranges,
+    breaking per-host fold accounting); the column-blocked layout must
+    give each host whole contiguous columns."""
+    from dss_tpu.parallel.mesh import make_global_mesh
+
+    pl = make_global_mesh(dp=2, devices=_fake_world([4, 4]))
+    assert pl.sp == 4
+    assert pl.sp_by_process == {0: (0, 1), 1: (2, 3)}
+    # every column single-owner
+    for j in range(pl.sp):
+        assert len(set(int(x) for x in pl.owner[:, j])) == 1
+
+
+def test_global_mesh_rejects_indivisible_dp():
+    """A dp that does not divide some host's device count cannot give
+    contiguous process-pure columns — must FAIL LOUDLY, not silently
+    produce a placement whose owner map lies."""
+    from dss_tpu.parallel.mesh import make_global_mesh
+
+    with pytest.raises(ValueError, match="non-contiguous"):
+        make_global_mesh(dp=2, devices=_fake_world([3, 3]))
+
+
+def test_global_mesh_member_filter():
+    """`processes=` restricts the mesh to member processes' devices —
+    the elastic-membership surface."""
+    from dss_tpu.parallel.mesh import make_global_mesh
+
+    pl = make_global_mesh(
+        dp=1, devices=_fake_world([2, 2, 2]), processes=(0, 1)
+    )
+    assert pl.sp == 4
+    assert set(pl.sp_by_process) == {0, 1}
+    with pytest.raises(ValueError, match="no devices"):
+        make_global_mesh(
+            dp=1, devices=_fake_world([2, 2]), processes=(7,)
+        )
+
+
+# -- rebalance decision (hysteresis + move cap) --------------------------------
+
+
+def _mk_replica(tmp_path, records, name, **kw):
+    from dss_tpu.parallel.replica import ShardedReplica
+
+    wal = str(tmp_path / f"{name}.wal")
+    open(wal, "w").close()
+    mesh = make_mesh(8, dp=1, sp=8)
+    rep = ShardedReplica(mesh, wal_path=wal, max_results=256,
+                         shard_results=48, **kw)
+    with rep._mu:
+        rep._records["isas"] = {r.entity_id: r for r in records}
+        rep._dirty["isas"] = True
+    rep.refresh(plan=False)
+    return rep
+
+
+def _mk_records(rng, n, key_space=8000, prefix="e"):
+    recs = []
+    for i in range(n):
+        k0 = int(rng.integers(0, key_space - 8))
+        keys = np.unique(
+            rng.integers(k0, k0 + 8, 3).astype(np.int32)
+        )
+        recs.append(Record(
+            entity_id=f"{prefix}{i}", keys=keys,
+            alt_lo=0.0, alt_hi=3000.0,
+            t_start=-(2**62), t_end=2**62,
+            owner_id=0,
+        ))
+    return recs
+
+
+def test_hysteresis_no_move_below_threshold(tmp_path):
+    """Mild imbalance under the ratio must be a strict no-op: no
+    boundary move, no forced major."""
+    rng = np.random.default_rng(5)
+    rep = _mk_replica(
+        tmp_path, _mk_records(rng, 300), "hys",
+        rebalance_ratio=10.0, move_interval_s=0.0,
+    )
+    try:
+        rep.load = RangeLoad(shift=3)
+        for _ in range(20):
+            rep.load.record(
+                np.arange(1000, 1100, dtype=np.int32), work=5.0
+            )
+        assert rep.plan_rebalance() is False
+        assert rep.boundary_moves == 0
+        assert rep.boundaries is None
+        assert rep._imbalance > 1.0  # measured, just under threshold
+    finally:
+        rep.close()
+
+
+def test_move_rate_cap_blocks_back_to_back_moves(tmp_path):
+    """The move-rate cap: a second rebalance inside the interval is
+    deferred even when imbalance is over threshold (a rebalance storm
+    can never starve serving with major folds)."""
+    rng = np.random.default_rng(6)
+    rep = _mk_replica(
+        tmp_path, _mk_records(rng, 300), "cap",
+        rebalance_ratio=1.2, move_interval_s=3600.0,
+    )
+    try:
+        rep.load = RangeLoad(shift=3)
+        for _ in range(20):
+            rep.load.record(
+                np.arange(1000, 1200, dtype=np.int32), work=100.0
+            )
+        assert rep.plan_rebalance(now=1000.0) is True
+        assert rep.boundary_moves == 1
+        rep.refresh(plan=False)
+        # shift the hot spot: imbalance over threshold again, but the
+        # interval has not elapsed
+        for _ in range(40):
+            rep.load.record(
+                np.arange(6000, 6200, dtype=np.int32), work=200.0
+            )
+        assert rep.plan_rebalance(now=1001.0) is False
+        assert rep.boundary_moves == 1
+        # after the interval, the move is allowed
+        assert rep.plan_rebalance(now=1000.0 + 3601.0) is True
+        assert rep.boundary_moves == 2
+    finally:
+        rep.close()
+
+
+# -- differential fuzz ---------------------------------------------------------
+
+
+def _query_pair(rng, reps, key_space=8000, q=8):
+    areas = []
+    for _ in range(q):
+        k0 = int(rng.integers(0, key_space - 32))
+        areas.append(np.arange(k0, k0 + 32, dtype=np.int32))
+    args = (
+        np.full(q, -np.inf, np.float32),
+        np.full(q, np.inf, np.float32),
+        np.full(q, -(2**62), np.int64),
+        np.full(q, 2**62, np.int64),
+    )
+    outs = []
+    for rep in reps:
+        outs.append(
+            rep.query_batch(areas, *args, now=0, cls="isas")
+        )
+    # the host-side record map is the exact oracle both must match
+    host = reps[0].query_batch_host(areas, *args, now=0, cls="isas")
+    return outs, host
+
+
+@pytest.mark.slow
+def test_differential_fuzz_rebalanced_vs_static(tmp_path):
+    """THE correctness bar: a rebalanced replica and a static replica
+    fed the identical write stream answer bit-identically after every
+    phase — interleaved writes, delta folds, major compactions, and a
+    mid-sequence forced boundary move — and both match the exact
+    host-side answer.  Placement is a performance mapping; answers
+    must never depend on it."""
+    rng = np.random.default_rng(7)
+    base = _mk_records(rng, 250)
+    reb = _mk_replica(
+        tmp_path, list(base), "reb",
+        rebalance_ratio=1.3, move_interval_s=0.0,
+    )
+    static = _mk_replica(
+        tmp_path, list(base), "static",
+        rebalance_ratio=0.0,
+    )
+    nxt = [len(base)]
+    try:
+        for phase in range(5):
+            # interleaved writes: adds, updates (shadowing), deletes
+            adds = _mk_records(
+                rng, 30, prefix=f"p{phase}_"
+            )
+            with reb._mu, static._mu:
+                live_ids = list(reb._records["isas"])
+            upd = [
+                reb._records["isas"][i]
+                for i in rng.choice(
+                    live_ids, size=min(10, len(live_ids)),
+                    replace=False,
+                )
+            ]
+            dels = [
+                str(i) for i in rng.choice(
+                    live_ids, size=min(6, len(live_ids)), replace=False
+                )
+            ]
+            import dataclasses
+
+            for rep in (reb, static):
+                with rep._mu:
+                    for r in adds:
+                        rep._put("isas", r)
+                    for r in upd:
+                        moved = dataclasses.replace(
+                            r,
+                            keys=np.unique(
+                                (r.keys + 37) % 8000
+                            ).astype(np.int32),
+                        )
+                        rep._put("isas", moved)
+                    for eid in dels:
+                        rep._del("isas", eid)
+            nxt[0] += len(adds)
+            if phase == 1:
+                # force a major compaction on both (tombstone GC)
+                with reb._mu, static._mu:
+                    reb._force_major["isas"] = True
+                    static._force_major["isas"] = True
+            if phase == 2:
+                # the mid-sequence boundary move: hammer a hot range
+                # on the rebalanced replica only
+                reb.load = RangeLoad(shift=3)
+                for _ in range(30):
+                    reb.load.record(
+                        np.arange(3000, 3300, dtype=np.int32),
+                        work=150.0,
+                    )
+                assert reb.plan_rebalance() is True
+            reb.refresh(plan=False)
+            static.refresh(plan=False)
+            (a, b), host = _query_pair(rng, (reb, static))
+            assert a == b, f"phase {phase}: rebalanced != static"
+            assert a == host, f"phase {phase}: mesh != host oracle"
+        # the rebalanced replica really did move boundaries mid-run
+        assert reb.boundary_moves >= 1
+        assert reb.shard_stats()["dss_shard_boundary_moves"] >= 1
+        assert static.boundary_moves == 0
+    finally:
+        reb.close()
+        static.close()
+
+
+def test_uniform_load_never_moves_boundaries(tmp_path):
+    """The acceptance gauge: under uniform query load on uniform data
+    the rebalancer must be silent — dss_shard_boundary_moves stays 0
+    across fuzz-style write/fold cycles."""
+    rng = np.random.default_rng(8)
+    rep = _mk_replica(
+        tmp_path, _mk_records(rng, 250), "uni",
+        rebalance_ratio=1.5, move_interval_s=0.0,
+    )
+    try:
+        for phase in range(3):
+            adds = _mk_records(rng, 15, prefix=f"u{phase}_")
+            with rep._mu:
+                for r in adds:
+                    rep._put("isas", r)
+            # uniform traffic: every area equally often, uniform data
+            for _ in range(40):
+                k0 = int(rng.integers(0, 8000 - 32))
+                rep.load.record(
+                    np.arange(k0, k0 + 32, dtype=np.int32), work=2.0
+                )
+            rep.refresh()  # plan=True: the real serving path
+        assert rep.boundary_moves == 0, "uniform load moved boundaries"
+        assert (
+            rep.shard_stats()["dss_shard_boundary_moves"] == 0
+        )
+        assert rep.boundaries is None
+    finally:
+        rep.close()
