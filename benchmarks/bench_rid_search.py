@@ -204,9 +204,10 @@ def _stage_summary(samples):
     return out
 
 
-def boot_server(port, storage, workers, wal_path=None):
+def boot_server(port, storage, workers, wal_path=None, extra=()):
     """Launch the real server binary (no auth, stage tracing on) and
-    return the Popen.  Callers own terminate/kill."""
+    return the Popen.  Callers own terminate/kill.  `extra` appends
+    verbatim flags (e.g. --autotune_profile for the plan smoke)."""
     argv = [
         sys.executable, "-m", "dss_tpu.cmds.server",
         "--addr", f":{port}",
@@ -220,6 +221,7 @@ def boot_server(port, storage, workers, wal_path=None):
         # --workers N serves searches from WAL-tail replicas: the
         # leader must journal for the read workers to have a tail
         argv += ["--wal_path", str(wal_path)]
+    argv += list(extra)
     return subprocess.Popen(argv, env=dict(os.environ, DSS_LOG_LEVEL="error"))
 
 

@@ -239,6 +239,25 @@ def worker(args) -> None:
         warm_batches=(1,),
         members=members,
     )
+    if args.capacity:
+        # per-HOST capacity weights (the autotune profiles'
+        # capacity_weight scalars) expanded to the per-sp-shard vector
+        # the splitter consumes: every column a host owns carries that
+        # host's capacity, so a slow host's key runs come out
+        # proportionally lighter.  Leader-only input (followers apply
+        # broadcast boundaries verbatim) but harmless everywhere.
+        import numpy as np
+
+        host_cap = [float(x) for x in args.capacity.split(",")]
+        if len(host_cap) < runtime.num_processes:
+            raise SystemExit(
+                f"--capacity needs {runtime.num_processes} entries"
+            )
+        cap_vec = np.ones(placement.sp, np.float64)
+        for pid, cols in placement.sp_by_process.items():
+            for c in cols:
+                cap_vec[c] = host_cap[int(pid)]
+        replica._inner.capacity_weights = cap_vec
     # the elastic leg forces a deterministic hot-range boundary move:
     # lift the move-rate cap so the forced rebalance fires on the very
     # next fold instead of waiting out the production default
@@ -374,6 +393,33 @@ def worker(args) -> None:
         leave_res = _run_queries(replica, keys_list, now)
         out["leave"]["match"] = leave_res == out["wave_b"]
 
+    if args.capacity:
+        import numpy as np
+
+        # heterogeneous-capacity placement (PR 8 follow-up): force a
+        # load-driven move with per-host capacity weights active — the
+        # split targets skew toward the fast host, the per-shard
+        # result capacity re-sizes from the post-move predicted load,
+        # and the ANSWERS must not move a bit (placement is never
+        # allowed to change results)
+        inner = replica._inner
+        hot = keys_list[0]
+        for _ in range(20):
+            inner.load.record(hot, work=200.0)
+        inner._last_decay = float("-inf")
+        replica.sync()  # plans under capacity weights + broadcasts
+        out["capmove"] = {
+            "capacity": [float(x) for x in args.capacity.split(",")],
+            "boundary_moves": inner.boundary_moves,
+            "boundaries": (
+                None if inner.boundaries is None
+                else [int(x) for x in inner.boundaries]
+            ),
+            "shard_results_cap": int(inner._build_shard_results() or 0),
+            "match": _run_queries(replica, keys_list, now)
+            == out["wave_b"],
+        }
+
     if args.peerloss and runtime.num_processes > 1:
         replica.broadcast_control("die")
         deadline = time.monotonic() + 3 * args.watchdog_timeout + 5
@@ -436,6 +482,7 @@ def _run_leg(
     peerloss: bool = False,
     members: str = "",
     elastic: bool = False,
+    capacity: str = "",
     reps: int = 3,
     watchdog_interval: float = 0.25,
     watchdog_timeout: float = 2.0,
@@ -462,6 +509,8 @@ def _run_leg(
         common += ["--members", members]
     if elastic:
         common.append("--elastic")
+    if capacity:
+        common += ["--capacity", capacity]
     procs = []
     for i in range(num_processes):
         argv = ["--process_id", str(i), *common]
@@ -506,6 +555,7 @@ def run_dryrun(
     reps: int = 3,
     timeout_s: float = 600.0,
     elastic: bool = True,
+    capacity: bool = True,
 ) -> dict:
     """The full acceptance: fixture -> single-process reference ->
     N-process mesh (bit-identical check) -> peer-loss leg (degraded
@@ -609,6 +659,34 @@ def run_dryrun(
             k: v for k, v in el.items() if k != "leader"
         }
         out["ok"] = bool(out["ok"] and elastic_ok)
+    if capacity:
+        # heterogeneous hosts: process 1 declared at 40% capacity —
+        # the weighted split hands it lighter key runs, a forced hot
+        # move runs under those weights, and every answer stays
+        # bit-identical to the homogeneous single-process reference
+        cap = _run_leg(
+            os.path.join(workdir, "capacity"),
+            fixture,
+            num_processes,
+            devices_per_process=devices_per_process,
+            capacity=",".join(
+                ["1.0"] + ["0.4"] * (num_processes - 1)
+            ),
+            reps=1,
+            timeout_s=timeout_s,
+        )
+        cw = cap.get("leader", {})
+        cm = cw.get("capmove", {})
+        capacity_ok = bool(
+            cap["ok"]
+            and cw.get("wave_a") == ref["leader"]["wave_a"]
+            and cw.get("wave_b") == ref["leader"]["wave_b"]
+            and cm.get("match")
+            and cm.get("boundary_moves", 0) >= 1
+        )
+        out["capacity_ok"] = capacity_ok
+        out["capacity"] = cw.get("capmove", cap.get("rcs"))
+        out["ok"] = bool(out["ok"] and capacity_ok)
     return out
 
 
@@ -633,6 +711,13 @@ def main():
         "--elastic", action="store_true",
         help="leader runs the elasticity schedule: forced hot-range "
         "boundary move, host join via snapshot+tail, graceful leave",
+    )
+    ap.add_argument(
+        "--capacity", default="",
+        help="csv of per-HOST capacity weights (one per process; the "
+        "autotune profiles' capacity_weight scalars): the leader "
+        "splits key runs proportionally and the leg asserts answers "
+        "stay bit-identical with weights on",
     )
     ap.add_argument("--watchdog_interval", type=float, default=0.25)
     ap.add_argument("--watchdog_timeout", type=float, default=2.0)

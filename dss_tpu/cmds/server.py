@@ -87,6 +87,20 @@ def make_parser() -> argparse.ArgumentParser:
         "(reference --gcp_prof_service_name analog)",
     )
     p.add_argument(
+        "--autotune_profile",
+        default=os.environ.get("DSS_AUTOTUNE_PROFILE", ""),
+        help="autotune profile JSON (dss_tpu/plan/autotune.py; "
+        "emitted by `bench.py --leg autotune` into deploy/autotune/"
+        "<host-class>.json): seeds the planner's cost models, the "
+        "resident ring/stream depth, the AOT bucket grids, and the "
+        "sharded replica's per-shard result capacity from MEASURED "
+        "microbenchmarks, so a fresh process serves with converged "
+        "estimates instead of paying the EWMA learning window under "
+        "live traffic.  Knob precedence: explicit DSS_* env > "
+        "profile > built-in defaults.  Env fallback "
+        "DSS_AUTOTUNE_PROFILE",
+    )
+    p.add_argument(
         "--region_url",
         default="",
         help="region log server URL(s), comma-separated primary + "
@@ -712,6 +726,24 @@ def main():
     import tempfile
 
     args = make_parser().parse_args()
+
+    if args.autotune_profile:
+        # seed serving knobs from the measured host profile BEFORE any
+        # store/coalescer construction reads the env (env > profile >
+        # defaults; worker children inherit the seeded environment)
+        from dss_tpu.plan import autotune as _autotune
+
+        from dss_tpu.obs.logging import get_logger
+
+        profile = _autotune.load_profile(args.autotune_profile)
+        applied = _autotune.apply_profile(profile)
+        get_logger("dss.server").info(
+            "autotune profile %s (host class %s): seeded %s",
+            args.autotune_profile,
+            profile.get("host_class", "?"),
+            ", ".join(f"{k}={v}" for k, v in sorted(applied.items()))
+            or "nothing (env overrides everything)",
+        )
 
     from dss_tpu.cmds import make_ssl_context
 

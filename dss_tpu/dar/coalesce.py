@@ -72,6 +72,17 @@ Clockwork-style predictable-latency admission):
   cold-dispatch estimate.  A full resident ring falls back to the cold
   path; the pack stage never blocks on the device stream.
 
+  PLANNED ROUTING — as of the plan layer (dss_tpu/plan), every route
+  decision here is a Plan produced by one Planner that owns ALL cost
+  models: the pack stage, the inline lone-caller path, the drain cap,
+  and the Retry-After estimate consume plans instead of re-deriving
+  costs, so the drain sizing and the route choice can never disagree,
+  and a decision is a pure function of (batch shape, model state,
+  clock) — unit-testable with no live coalescer, no device, no
+  threads (tests/test_planner.py pins decision-identity against the
+  pre-planner router).  Adding a route touches dss_tpu/plan/planner.py
+  only.
+
 This replaces the reference's per-request SQL round trip to CRDB
 (goroutine-per-RPC, pkg/rid/cockroach/identification_service_area.go
 :166-197) with the TPU-idiomatic shape: request parallelism becomes
@@ -93,6 +104,16 @@ from dss_tpu.dar import budget
 from dss_tpu.dar import deadline as _deadline
 from dss_tpu.obs import stages as _stages
 from dss_tpu.ops.conflict import NO_TIME_HI, NO_TIME_LO
+from dss_tpu.plan import (
+    HEADROOM_SAFETY as _PLAN_HEADROOM_SAFETY,
+)
+from dss_tpu.plan import (
+    BatchShape,
+    CostModel,
+    Planner,
+    plan_drain_cap,
+)
+from dss_tpu.plan.planner import state_of as _plan_state_of
 
 
 class _Item:
@@ -125,219 +146,10 @@ class _Item:
         return self.deadline is not None and self.deadline <= now_monotonic
 
 
-class _CostModel:
-    """Online EWMA cost estimates for the three serving routes.
-
-    Four scalars, seeded at boot (DSS_CO_EST_* knobs) and updated
-    from every completed batch:
-
-      est_floor_ms — the COLD device dispatch floor: what one
-          fused-kernel round trip costs before any per-query work
-          (tunneled ~110 ms in this dev environment, sub-ms on an
-          attached TPU).
-      est_item_ms  — marginal device cost per batched query on top of
-          the floor (device batch time modeled as floor + item * n).
-      est_chunk_ms — one warmed-bucket exact host scan
-          (FastTable.query_host_chunked serves an n-item batch as
-          ceil(n / chunk) of these).
-      est_res_floor_ms — the RESIDENT dispatch floor: the steady-state
-          marginal per-batch cost of the resident loop's device stream
-          (ops/resident.py — AOT buckets + donated I/O + pipelined
-          feeder).  Its OWN key on purpose: resident observations
-          never feed the cold floor and vice versa — with one shared
-          floor, whichever route runs more would drag the estimate
-          toward itself and poison routing for the other (a resident
-          steady state would make cold dispatches look free; one cold
-          dispatch would make the resident stream look floor-bound).
-      est_res_lat_ms — the resident stream's full per-batch LATENCY
-          (submit -> delivered), tracked separately from the floor:
-          pipelining amortizes *dispatch cost* but every batch still
-          rides one full round trip, so on a high-RTT host the stream
-          drains at floor rates while each batch takes ~RTT wall
-          clock.  Headroom (deadline) decisions use the latency;
-          throughput decisions (bulk routing, Retry-After, drain
-          pacing) use the floor.  Conflating them would route
-          fresh-SLO traffic into a stream it can never make deadlines
-          through.
-
-    The cold-device pair is an exponentially-forgetting online
-    least-squares fit over observed (n, total_ms) pairs: the EWMA
-    first/second moments give slope = cov(n, t) / var(n) and floor =
-    mean(t) - slope * mean(n).  While every batch is the same size,
-    var(n) ~ 0 and the seed slope stands with the floor absorbing the
-    level (the prediction AT observed sizes is exact, which is what
-    the router compares against headroom); mixed sizes disambiguate
-    the split.  The resident floor is a plain EWMA of the observed
-    level minus the (shared) per-item slope — the compute cost per
-    query is the same kernel either way; only the dispatch differs."""
-
-    __slots__ = ("alpha", "chunk", "est_floor_ms", "est_item_ms",
-                 "est_chunk_ms", "est_res_floor_ms", "est_res_lat_ms",
-                 "device_obs", "host_obs", "resident_obs",
-                 "_sn", "_st", "_snn", "_snt")
-
-    def __init__(self, *, floor_ms: float = 20.0, item_ms: float = 0.02,
-                 chunk_ms: float = 0.3, chunk: int = 64,
-                 alpha: float = 0.2,
-                 res_floor_ms: Optional[float] = None,
-                 res_lat_ms: Optional[float] = None):
-        self.alpha = float(alpha)
-        self.chunk = max(1, int(chunk))
-        self.est_floor_ms = float(floor_ms)
-        self.est_item_ms = float(item_ms)
-        self.est_chunk_ms = float(chunk_ms)
-        # default resident seed: the cold floor amortized over the
-        # loop's default in-flight window — deliberately conservative
-        # (a quarter, not a tenth) so the first resident batches must
-        # EARN a lower floor before the router leans on it
-        self.est_res_floor_ms = (
-            self.est_floor_ms / 4.0
-            if res_floor_ms is None
-            else float(res_floor_ms)
-        )
-        # latency seed: a batch entering an idle stream pays one full
-        # round trip — the cold floor is the honest prior, so
-        # high-RTT hosts don't bet fresh deadlines on the stream until
-        # it has MEASURED low latency
-        self.est_res_lat_ms = (
-            self.est_floor_ms if res_lat_ms is None else float(res_lat_ms)
-        )
-        self.device_obs = 0
-        self.host_obs = 0
-        self.resident_obs = 0
-        # EWMA moments of (n, total_ms) for the device fit, primed
-        # from the seed (at a representative batch size) so the first
-        # observations BLEND into the seeded estimate instead of
-        # replacing it wholesale
-        n0 = float(4 * self.chunk)
-        t0 = self.est_floor_ms + self.est_item_ms * n0
-        self._sn = n0
-        self._st = t0
-        self._snn = n0 * n0
-        self._snt = n0 * t0
-
-    def _chunks(self, n: int) -> int:
-        return max(1, -(-int(n) // self.chunk))
-
-    def observe_device(self, n: int, total_ms: float) -> None:
-        a = self.alpha
-        n = float(max(1, n))
-        # winsorize: one outlier batch (an unwarmed-bucket XLA compile
-        # can cost seconds vs a ~100 ms floor) must not poison the
-        # floor estimate — under fresh-SLO-only traffic a poisoned-high
-        # floor routes everything hostward and the device is never
-        # re-sampled to correct it.  Clamping each observation to 4x
-        # the current prediction bounds a single outlier's pull while
-        # a GENUINE floor shift still converges (the clamp ratchets up
-        # with the prediction each step).
-        total_ms = min(
-            float(total_ms), 4.0 * max(self.predict_device_ms(n), 0.05)
-        )
-        self._sn += a * (n - self._sn)
-        self._st += a * (total_ms - self._st)
-        self._snn += a * (n * n - self._snn)
-        self._snt += a * (n * total_ms - self._snt)
-        var = self._snn - self._sn * self._sn
-        if var > 1e-6 * max(self._snn, 1.0):
-            self.est_item_ms = max(
-                0.0, (self._snt - self._sn * self._st) / var
-            )
-        # else: single-size traffic so far — keep the seeded slope
-        self.est_floor_ms = max(
-            0.05, self._st - self.est_item_ms * self._sn
-        )
-        self.device_obs += 1
-
-    def observe_host(self, n: int, total_ms: float) -> None:
-        per = total_ms / self._chunks(n)
-        self.est_chunk_ms += self.alpha * (per - self.est_chunk_ms)
-        self.host_obs += 1
-
-    def observe_resident(self, n: int, gap_ms: float,
-                         lat_ms: Optional[float] = None) -> None:
-        """Feed ONLY the resident keys: gap_ms is the loop's marginal
-        per-batch cost (inter-completion gap), so level = gap -
-        item * n is the amortized dispatch floor; lat_ms is the full
-        submit->delivered wall time feeding the latency EWMA the
-        deadline comparisons use.  Both winsorized like the cold fit —
-        one stall (GC pause, tunnel hiccup) must not route a steady
-        stream hostward."""
-        gap_ms = min(
-            float(gap_ms),
-            4.0 * max(self.predict_resident_ms(n), 0.05),
-        )
-        lvl = gap_ms - self.est_item_ms * float(max(1, n))
-        self.est_res_floor_ms = max(
-            0.02,
-            self.est_res_floor_ms
-            + self.alpha * (lvl - self.est_res_floor_ms),
-        )
-        if lat_ms is not None:
-            lat_ms = min(
-                float(lat_ms),
-                4.0 * max(self.predict_resident_latency_ms(n), 0.05),
-            )
-            lat_lvl = lat_ms - self.est_item_ms * float(max(1, n))
-            self.est_res_lat_ms = max(
-                0.02,
-                self.est_res_lat_ms
-                + self.alpha * (lat_lvl - self.est_res_lat_ms),
-            )
-        self.resident_obs += 1
-
-    def predict_device_ms(self, n: int, inflight: int = 0) -> float:
-        # batches already in the device stream must clear first; with
-        # the double-buffered pipeline each adds ~a floor of wait
-        return (
-            self.est_floor_ms * (1 + max(0, int(inflight)))
-            + self.est_item_ms * n
-        )
-
-    def predict_resident_ms(self, n: int, inflight: int = 0) -> float:
-        # THROUGHPUT view: the resident stream pipelines, so each
-        # batch already queued at the loop adds ~one resident floor of
-        # wait, not a cold floor.  Use for bulk routing / drain pacing.
-        return (
-            self.est_res_floor_ms * (1 + max(0, int(inflight)))
-            + self.est_item_ms * n
-        )
-
-    def predict_resident_latency_ms(self, n: int,
-                                    inflight: int = 0) -> float:
-        # LATENCY view: one full stream round trip (pipelining never
-        # removes it) plus a floor of queue wait per batch ahead.  Use
-        # for headroom (deadline) comparisons.
-        return (
-            self.est_res_lat_ms
-            + self.est_res_floor_ms * max(0, int(inflight))
-            + self.est_item_ms * n
-        )
-
-    def predict_host_ms(self, n: int, inflight_chunks: int = 0,
-                        inflight_device: int = 0) -> float:
-        # work already queued at the single collect thread serializes
-        # ahead of this batch: forced host chunks scan there, and a
-        # pending DEVICE batch blocks it in wait_device() for ~a floor
-        # — without both terms a host batch behind a predecessor would
-        # be predicted at a fraction of its real completion
-        return (
-            (self._chunks(n) + max(0, int(inflight_chunks)))
-            * self.est_chunk_ms
-            + max(0, int(inflight_device)) * self.est_floor_ms
-        )
-
-    def host_qps(self) -> float:
-        """Host-chunk route drain throughput estimate."""
-        return self.chunk / max(self.est_chunk_ms, 1e-3) * 1000.0
-
-    def min_route_qps(self, n: int) -> float:
-        """Conservative drain throughput at drain size n: the SLOWER
-        of the two routes — the Retry-After fallback before any drain
-        has been measured (a cold-start overload may be bulk/stale
-        traffic that drains at device-floor-limited rates, so quoting
-        the host route's throughput would invite a retry storm)."""
-        dev = n / max(self.predict_device_ms(n), 1e-3) * 1000.0
-        return min(self.host_qps(), dev)
+# The cost model moved to dss_tpu/plan/costs.py (the planner owns it
+# now); the name is re-exported here because the serving tests and
+# docs grew up calling it _CostModel.
+_CostModel = CostModel
 
 
 class _BatchController:
@@ -383,47 +195,20 @@ class _BatchController:
         inflight: int, inflight_host_chunks: int = 0,
         resident_ready: bool = False, inflight_resident: int = 0,
     ) -> int:
-        """Deadline-aware drain bound: never drain more than the
-        predicted route cost fits into the minimum queued headroom.
-        With rich headroom (the device-class route — resident stream
-        when available, else cold dispatch — fits inside the budget)
-        the AIMD size stands; under pressure — and only when the host
-        route is the one that will actually be chosen (same
-        _HEADROOM_SAFETY budget as the route choice, so the two
-        decisions cannot disagree) — the drain shrinks to the host
-        chunks that fit, never below one warmed chunk (forward
-        progress — a zero cap would starve the queue entirely)."""
-        if headroom_ms is None:
-            return self.cur
-        budget_ms = _HEADROOM_SAFETY * max(0.0, headroom_ms)
-        pred_dev = cost.predict_device_ms(self.cur, inflight)
-        if resident_ready:
-            # latency view, matching the route choice: a drain sized
-            # against the stream's throughput gap would admit batches
-            # the stream cannot deliver inside their deadlines
-            pred_dev = min(
-                pred_dev,
-                cost.predict_resident_latency_ms(
-                    self.cur, inflight_resident
-                ),
-            )
-        if pred_dev <= budget_ms:
-            return self.cur
-        if (
-            cost.predict_host_ms(self.cur, inflight_host_chunks, inflight)
-            >= pred_dev
-        ):
-            # the device is the lesser evil even over budget: shrinking
-            # the drain would only pay MORE dispatch floors
-            return self.cur
-        fit = (
-            int(
-                (budget_ms - inflight * cost.est_floor_ms)
-                / max(cost.est_chunk_ms, 1e-3)
-            )
-            - max(0, int(inflight_host_chunks))
+        """Deadline-aware drain bound — the logic lives in
+        plan.plan_drain_cap (one HEADROOM_SAFETY budget shared with
+        the route choice, so the drain sizing and the plan can never
+        disagree); this shim keeps the controller's historical call
+        shape for callers that hold a bare cost model (the coalescer
+        itself goes through its planner in _drain_locked)."""
+        state = _plan_state_of(
+            cost,
+            inflight_device=int(inflight),
+            inflight_host_chunks=int(inflight_host_chunks),
+            inflight_resident=int(inflight_resident),
+            resident_ready=bool(resident_ready),
         )
-        return max(cost.chunk, min(self.cur, cost.chunk * max(1, fit)))
+        return plan_drain_cap(self.cur, headroom_ms, state)
 
 
 def _env_bool(v: str) -> bool:
@@ -477,11 +262,11 @@ def env_knobs() -> dict:
 # inflight-queue sentinel: tells the collect stage to exit
 _DONE = object()
 
-# fraction of a batch's tightest headroom the router budgets for the
-# serving route itself (the rest covers decode + caller wake).  Shared
-# by _BatchController.drain_cap and _choose_host_route so the drain
-# sizing and the route choice can never disagree about the budget.
-_HEADROOM_SAFETY = 0.5
+# fraction of a batch's tightest headroom the planner budgets for the
+# serving route itself (the rest covers decode + caller wake) — the
+# value now lives in dss_tpu/plan/planner.py, shared by the route
+# choice and plan_drain_cap so they can never disagree.
+_HEADROOM_SAFETY = _PLAN_HEADROOM_SAFETY
 
 
 class QueryCoalescer:
@@ -556,11 +341,17 @@ class QueryCoalescer:
             chunk = _FT.HOST_MAX_BATCH
         except Exception:  # pragma: no cover
             chunk = 64
-        self._cost = _CostModel(
+        # the planner owns ALL cost models (dss_tpu/plan): every route
+        # decision, the drain sizing, and the Retry-After throughput
+        # read the same estimates through it.  self._cost stays as the
+        # live CostModel alias — observation call sites and the
+        # routing tests address it directly.
+        self._planner = Planner(
             floor_ms=est_floor_ms, item_ms=est_item_ms,
             chunk_ms=est_chunk_ms, chunk=chunk,
             res_floor_ms=est_res_floor_ms, res_lat_ms=est_res_lat_ms,
         )
+        self._cost = self._planner.cost
         # resident loop (created on demand — needs a table with the
         # submit/collect split)
         self._res_loop = None
@@ -604,6 +395,8 @@ class QueryCoalescer:
         self._mesh_min = 64
         self._mesh_max = 256  # beyond this, ONE local fused dispatch
         #                       beats serialized mesh chunk round trips
+        self._mesh_bgen = None  # replica boundary-generation getter:
+        #   plans record WHICH shard placement they were made against
         self.mesh_offloads = 0
 
     def _make_resident_loop(self):
@@ -662,15 +455,20 @@ class QueryCoalescer:
         itself (its own serving entry), never double-counted here."""
         self._load_view = load
 
-    def set_mesh_delegate(self, fn, fresh_fn, min_batch: int = 64):
+    def set_mesh_delegate(self, fn, fresh_fn, min_batch: int = 64,
+                          bgen_fn=None):
         """Route batches of >= min_batch bounded-staleness queries
         (every item flagged allow_stale, no owner filters) to `fn`
         (the ShardedReplica mesh) when fresh_fn() says the replica is
         caught up.  Conflict prechecks never set allow_stale, so
-        correctness-critical reads always hit the local table."""
+        correctness-critical reads always hit the local table.
+        `bgen_fn` (optional) reports the replica's shard-boundary
+        generation so every Plan records which placement it was
+        decided against."""
         self._mesh_fn = fn
         self._mesh_fresh = fresh_fn
         self._mesh_min = min_batch
+        self._mesh_bgen = bgen_fn
 
     def configure(
         self,
@@ -737,15 +535,44 @@ class QueryCoalescer:
         """Queue-drain horizon estimate for the 429 Retry-After: live
         backlog (queued + actually in-flight items, not a batch-size
         guess) over the measured drain-rate EWMA.  Before any drain
-        has been observed, the cost model's SLOWER-route throughput at
-        the current drain size stands in — an honest model-derived
-        floor rather than a static 1 s guess (quoting the fast host
-        route during a device-bound cold-start overload would invite
-        a synchronized retry storm)."""
+        has been observed, the PLANNER's best-plan throughput for the
+        queued shape class stands in — the throughput of the route it
+        would actually choose for what is queued, not an unconditional
+        min(host, device).  The old fallback quoted `min_route_qps`
+        even when the planner would never pick that route for the
+        queued traffic: an all-stale bulk overload the resident
+        stream absorbs was told to wait at cold-dispatch-floor rates
+        (5 s horizons inviting synchronized retry storms), and a
+        fresh-SLO overload draining hostward was quoted device
+        throughput it will never see."""
         backlog = len(self._queue) + self._inflight_items
         qps = self._ema_qps
         if qps <= 1.0:
-            qps = max(1.0, self._cost.min_route_qps(self._ctl.cur))
+            # plan for what is ACTUALLY queued: the drained shape the
+            # pack stage will see next (same headroom scan as
+            # _drain_locked, same shape derivation as _shape_of)
+            look = self._queue[: self._ctl.cur]
+            now_m = self._clock()
+            headroom_ms = None
+            for it in look:
+                if (
+                    it.deadline is not None
+                    and not it.allow_stale
+                    and not it.expired(now_m)
+                ):
+                    h = (it.deadline - now_m) * 1000.0
+                    if headroom_ms is None or h < headroom_ms:
+                        headroom_ms = h
+            all_stale = bool(look) and all(
+                it.allow_stale for it in look
+            )
+            qps = max(
+                1.0,
+                self._planner.backlog_qps(
+                    self._ctl.cur, self._capture_state(), headroom_ms,
+                    all_stale=all_stale,
+                ),
+            )
         return min(5.0, max(0.05, backlog / qps))
 
     def query(
@@ -899,11 +726,52 @@ class QueryCoalescer:
 
     # -- pipeline stages ------------------------------------------------------
 
+    def _shape_of(self, batch: List[_Item],
+                  inline: bool = False) -> BatchShape:
+        """The planner's view of a drained batch."""
+        # getattr defaults: the routing tests drive this with bare
+        # placeholder items (the pre-planner router read only len())
+        return BatchShape(
+            n=len(batch),
+            all_stale=all(
+                getattr(it, "allow_stale", False) for it in batch
+            ),
+            owner_scoped=any(
+                getattr(it, "owner_id", -1) >= 0 for it in batch
+            ),
+            inline=inline,
+        )
+
+    def _capture_state(self, host_only: bool = False):
+        """Freeze the planner's full decision input: live cost
+        estimates + this pipeline's pressure counters + which routes
+        are attached right now.  Racy unlocked reads of the pressure
+        counters are deliberate and unchanged from the pre-planner
+        router — a decision made one batch stale is still safe (the
+        counters only pad predictions)."""
+        bgen = 0
+        if self._mesh_bgen is not None:
+            try:
+                bgen = int(self._mesh_bgen())
+            except Exception:  # noqa: BLE001 — introspection only
+                bgen = 0
+        return self._planner.capture(
+            inflight_device=self._inflight_device,
+            inflight_host_chunks=self._inflight_host_chunks,
+            inflight_resident=self._inflight_resident,
+            resident_ready=self._resident_ready(),
+            mesh_ready=self._mesh_fn is not None,
+            mesh_min=self._mesh_min,
+            mesh_max=self._mesh_max,
+            host_only=host_only,
+            boundary_gen=bgen,
+        )
+
     def _mesh_eligible(self, batch: List[_Item]) -> bool:
-        return (
-            self._mesh_fn is not None
-            and self._mesh_min <= len(batch) <= self._mesh_max
-            and all(it.allow_stale and it.owner_id < 0 for it in batch)
+        from dss_tpu.plan.planner import mesh_admissible
+
+        return mesh_admissible(
+            self._shape_of(batch), self._capture_state()
         )
 
     def _drain_locked(self):
@@ -926,11 +794,8 @@ class QueryCoalescer:
                 h = (it.deadline - now_m) * 1000.0
                 if headroom_ms is None or h < headroom_ms:
                     headroom_ms = h
-        cap = self._ctl.drain_cap(
-            headroom_ms, self._cost, self._inflight_device,
-            self._inflight_host_chunks,
-            resident_ready=self._resident_ready(),
-            inflight_resident=self._inflight_resident,
+        cap = self._planner.drain_cap(
+            self._ctl.cur, headroom_ms, self._capture_state()
         )
         batch: List[_Item] = []
         expired: List[_Item] = []
@@ -953,60 +818,27 @@ class QueryCoalescer:
         already saturated — routing more at it would just queue)."""
         return self._res_loop is not None and self._res_loop.has_space()
 
+    def _plan_batch(self, batch, headroom_ms):
+        """Plan a pack-stage drain: ONE planner decision over all
+        attached routes (mesh / resident / cold device / forced host
+        chunks), recorded in the co_plan_* counters.  The policy
+        itself lives in dss_tpu/plan/planner.decide — a pure function
+        pinned decision-identical to the pre-planner router."""
+        return self._planner.plan(
+            self._shape_of(batch), self._capture_state(), headroom_ms,
+        )
+
     def _choose_route(self, batch, headroom_ms,
                       allow_resident: bool = True) -> str:
-        """The routing policy, now over THREE candidates.
-
-        Bulk / all-stale drains (headroom_ms None) are throughput
-        decisions: ride the resident stream whenever it is attached,
-        has ring space, and its marginal (gap) cost beats a cold
-        dispatch — else the cold fused kernel.
-
-        Deadline-carrying drains are latency decisions: the
-        device-class candidate is whichever of resident/cold predicts
-        the lower COMPLETION LATENCY (for the stream that includes the
-        full round trip — est_res_lat_ms — pipelining amortizes
-        dispatch cost, never the wire).  If that latency blows the
-        headroom budget (_HEADROOM_SAFETY of it — the same budget
-        drain_cap sizes against) AND the host chunks are predicted to
-        finish sooner, the drain is served as chunked exact host scans
-        ("hostchunk")."""
-        n = len(batch)
-        pred_dev = self._cost.predict_device_ms(
-            n, self._inflight_device
-        )
-        res_ok = allow_resident and self._resident_ready()
-        if headroom_ms is None:
-            if res_ok and (
-                self._cost.predict_resident_ms(
-                    n, self._inflight_resident
-                )
-                < pred_dev
-            ):
-                return "resident"
-            return "device"
-        dc_lat, kind = pred_dev, "device"
-        if res_ok:
-            res_lat = self._cost.predict_resident_latency_ms(
-                n, self._inflight_resident
-            )
-            # tie-break toward the stream: at the seed state the
-            # latency keys are EQUAL (both one round trip), and a
-            # strict compare would starve the resident route of the
-            # very observations that lower its estimate — equal
-            # latency, strictly cheaper dispatch
-            if res_lat <= pred_dev:
-                dc_lat, kind = res_lat, "resident"
-        if dc_lat <= _HEADROOM_SAFETY * headroom_ms:
-            return kind
-        if (
-            self._cost.predict_host_ms(
-                n, self._inflight_host_chunks, self._inflight_device,
-            )
-            < dc_lat
-        ):
-            return "hostchunk"
-        return kind
+        """Route-string view of the planner decision (the pre-planner
+        router's contract, kept for the routing tests): never returns
+        "mesh" — the mesh candidate was historically decided before
+        this comparison and still is (_plan_batch handles it)."""
+        return self._planner.plan(
+            self._shape_of(batch), self._capture_state(), headroom_ms,
+            allow_resident=allow_resident, allow_mesh=False,
+            record=False,
+        ).route
 
     def _choose_host_route(self, batch, headroom_ms) -> bool:
         """Boolean view of _choose_route for consumers that CANNOT
@@ -1066,13 +898,16 @@ class QueryCoalescer:
             host_route = False
             used_device = False
             try:
-                if not self._mesh_eligible(batch):
-                    submit = getattr(self._table, "query_many_submit", None)
-                    if submit is not None:
-                        route = self._choose_route(batch, headroom_ms)
-                        if route == "resident" and self._enqueue_resident(
-                            batch
-                        ):
+                submit = getattr(self._table, "query_many_submit", None)
+                if submit is not None:
+                    # ONE planner decision covers every attached route;
+                    # a "mesh" plan rides the synchronous exec path
+                    # exactly as the pre-planner mesh-eligibility
+                    # check did (freshness re-verified at execution,
+                    # local fallback re-plans inline)
+                    route = self._plan_batch(batch, headroom_ms).route
+                    if route == "resident":
+                        if self._enqueue_resident(batch):
                             # the resident loop owns this batch now:
                             # its feeder submits into the device
                             # stream, its collector delivers + feeds
@@ -1082,6 +917,12 @@ class QueryCoalescer:
                                 self._packing = False
                                 self._cond.notify_all()
                             continue
+                        # ring filled between the plan and the
+                        # enqueue: demote to a cold dispatch (the
+                        # pack stage never blocks on the stream)
+                        self._planner.note_fallback()
+                        route = "device"
+                    if route != "mesh":
                         host_route = route == "hostchunk"
                         if host_route:
                             # forced chunked host scans execute on the
@@ -1180,9 +1021,10 @@ class QueryCoalescer:
                         batch, self._table.query_many_collect(pq)
                     )
                 else:
-                    # mesh-eligible (or submit-less table): the full
+                    # mesh-planned (or submit-less table): the full
                     # synchronous path, mesh-first with local fallback
-                    self._execute(batch)
+                    # (plan already recorded at pack time)
+                    self._execute(batch, record_plan=False)
             except BaseException as e:  # noqa: BLE001 — deliver to callers
                 self._deliver_error(batch, e)
             collect_ms = (time.perf_counter() - t1) * 1000
@@ -1341,17 +1183,25 @@ class QueryCoalescer:
 
     # -- synchronous execution (inline path + mesh batches) -------------------
 
-    def _execute(self, batch: List[_Item], headroom_ms=None):
+    def _execute(self, batch: List[_Item], headroom_ms=None,
+                 record_plan: bool = True):
         try:
             b = len(batch)
-            if (
-                self._mesh_fn is not None
-                and self._mesh_min <= b <= self._mesh_max
-                and all(
-                    it.allow_stale and it.owner_id < 0 for it in batch
-                )
-                and self._mesh_fresh()
-            ):
+            # plan the synchronous execution: resident excluded (this
+            # runs on the caller's thread — a cold dispatch dressed as
+            # the stream would blow the deadline the stream's latency
+            # cleared), host_only honored (an event-loop caller never
+            # gets the raised-cap forced scans).  record_plan=False on
+            # the collect-stage path, whose batch was already planned
+            # at pack time.
+            plan = self._planner.plan(
+                self._shape_of(batch, inline=True),
+                self._capture_state(host_only=budget.is_host_only()),
+                headroom_ms,
+                allow_resident=False,
+                record=record_plan,
+            )
+            if plan.route == "mesh" and self._mesh_fresh():
                 try:
                     # chunk to the warmed jit bucket (the replica warms
                     # batch=min_batch per rebuild): a 65..4096 batch
@@ -1378,15 +1228,12 @@ class QueryCoalescer:
                         "mesh offload failed; serving batch locally"
                     )
             keys, lo, hi, t0s, t1s, now, owners = self._pack_args(batch)
-            # never force the 4x-raised-cap chunk scans onto a
-            # host-only caller (the event loop's inline-read budget):
-            # the auto path's 2^16 cap stays the loop's worst case,
-            # anything bigger raises NeedsDevice and re-routes on the
-            # executor where the router applies normally
-            host_route = (
-                not budget.is_host_only()
-                and self._choose_host_route(batch, headroom_ms)
-            )
+            # the plan already honored host-only callers (the event
+            # loop's inline-read budget): a host_only state makes the
+            # forced-chunk candidate inadmissible, so the auto path's
+            # 2^16 cap stays the loop's worst case and anything bigger
+            # raises NeedsDevice and re-routes on the executor
+            host_route = plan.route == "hostchunk"
             submit = getattr(self._table, "query_many_submit", None)
             t0 = time.perf_counter()
             used_device = None
@@ -1485,6 +1332,11 @@ class QueryCoalescer:
             co_res_aot_buckets=rs["aot_buckets"],
             co_res_aot_compile_ms_total=rs["aot_compile_ms_total"],
         )
+        # planner decision mix (co_plan_*): how often each of the six
+        # routes was the chosen plan — the cache row is filled from
+        # the read-cache view below (a hit IS a plan, chosen before
+        # this pipeline ever sees the query)
+        out.update(self._planner.stats())
         # per-class read-cache counters (co_cache_*): stable key set so
         # the /metrics series exist on every tpu-backend deployment
         view = self._cache_view
@@ -1495,5 +1347,8 @@ class QueryCoalescer:
                 co_cache_hits=0, co_cache_misses=0,
                 co_cache_invalidations=0,
             )
+        hits = int(out.get("co_cache_hits", 0) or 0)
+        out["co_plan_cache"] += hits
+        out["co_plan_total"] += hits
         out["mesh_offloads"] = self.mesh_offloads
         return out

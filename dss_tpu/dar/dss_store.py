@@ -1254,8 +1254,16 @@ class DSSStore:
 
                 return fn
 
+            def bgen_fn(_r=replica):
+                # plans record the shard placement generation they
+                # were decided against (MultihostReplica wraps the
+                # inner ShardedReplica that owns the boundary map)
+                inner = getattr(_r, "_inner", _r)
+                return getattr(inner, "boundary_gen", 0)
+
             co.set_mesh_delegate(
-                make(cls), replica.fresh, min_batch=min_batch
+                make(cls), replica.fresh, min_batch=min_batch,
+                bgen_fn=bgen_fn,
             )
         # one load map: coalescer-served AND replica-served traffic
         # accumulate into the store's RangeLoad, which the replica's

@@ -592,6 +592,10 @@ class MultihostReplica:
                 else [int(x) for x in inner.boundaries]
             ),
             "bgen": inner.boundary_gen,
+            # boundary-aware result capacity sized by the leader from
+            # the post-rebalance predicted load: ships with the map so
+            # every process builds identical result-slot shapes
+            "sres": inner.shard_results_effective,
         }
 
     def sync(self) -> None:
@@ -821,6 +825,7 @@ class MultihostReplica:
                             head.get("fp"),
                             boundaries=head.get("boundaries"),
                             bgen=head.get("bgen", 0),
+                            shard_results=head.get("sres"),
                         )
                     elif kind == "reform":
                         # membership change at the broadcast cut: tail
@@ -919,7 +924,8 @@ class MultihostReplica:
             )
 
     def _follower_refresh(
-        self, cut, leader_fp, boundaries=None, bgen: int = 0
+        self, cut, leader_fp, boundaries=None, bgen: int = 0,
+        shard_results=None,
     ) -> None:
         """Tail to the cut, adopt the leader's boundary map verbatim
         (the load measurement lives on the leader — followers must
@@ -935,7 +941,8 @@ class MultihostReplica:
         if not self.is_member:
             return
         inner = self._inner
-        inner.apply_boundaries(boundaries, bgen)
+        inner.apply_boundaries(boundaries, bgen,
+                               shard_results=shard_results)
         inner.refresh(plan=False)
         self._account_refresh_bytes()
 

@@ -296,12 +296,40 @@ class ShardedReplica:
         load: Optional[tiersmod.RangeLoad] = None,
         rebalance_ratio: Optional[float] = None,  # None = env
         move_interval_s: Optional[float] = None,  # None = env
+        capacity_weights=None,  # per-sp-shard host capacity vector
+        #   (weighted_boundaries member_capacity; assembled from the
+        #   member hosts' autotune profiles' capacity_weight scalars);
+        #   None = homogeneous members, the historical split
     ):
         if (wal_path is None) == (region_client is None):
             raise ValueError("exactly one of wal_path / region_client")
         self.mesh = mesh
         self.max_results = max_results
+        if shard_results is None:
+            # autotune-profile seam: DSS_SHARD_RESULTS carries the
+            # measured per-shard result capacity base (plan/autotune
+            # measure_hit_concentration); unset keeps the legacy
+            # max_results-sized default
+            raw = os.environ.get("DSS_SHARD_RESULTS", "")
+            shard_results = int(raw) if raw else None
         self.shard_results = shard_results
+        # boundary-aware autotuned capacity (leader-computed at each
+        # boundary move from the post-rebalance predicted per-shard
+        # load, broadcast with the move): what builds actually use.
+        # None = no move yet, the configured base stands.
+        self.shard_results_effective: Optional[int] = None
+        if capacity_weights is None:
+            self.capacity_weights = None
+        else:
+            cw = np.asarray(capacity_weights, np.float64).ravel()
+            # reject bad vectors HERE, not at some later fold: a zero
+            # entry would otherwise surface as inf imbalance + a
+            # ValueError from inside the leader's serving sync path
+            if not np.all(np.isfinite(cw)) or not np.all(cw > 0):
+                raise ValueError(
+                    "capacity_weights entries must be finite and > 0"
+                )
+            self.capacity_weights = cw
         self._tier_ratio = (
             tiersmod.env_policy().ratio
             if tier_ratio is None
@@ -631,12 +659,22 @@ class ShardedReplica:
             self._imbalance = 1.0
             return False
         w = self.load.weights_for(keys)
+        n_sp = self.mesh.shape["sp"]
+        cap = self.capacity_weights
+        if cap is not None and len(cap) != n_sp:
+            # mesh reshaped under an old capacity vector (reform /
+            # degrade): heterogeneity no longer maps — fall back to
+            # homogeneous rather than split against the wrong hosts
+            cap = None
         cur = self._predicted_shard_loads(keys, w, self.boundaries)
-        self._imbalance = imbalance_factor(cur)
+        # hysteresis on CAPACITY-NORMALIZED load: a slow host at its
+        # (lighter) target is balanced, not a hot spot
+        self._imbalance = imbalance_factor(
+            cur if cap is None else cur / cap
+        )
         if self._imbalance <= self.rebalance_ratio:
             return False
-        n_sp = self.mesh.shape["sp"]
-        new_b = weighted_boundaries(keys, w, n_sp)
+        new_b = weighted_boundaries(keys, w, n_sp, member_capacity=cap)
         if new_b is None or (
             self.boundaries is not None
             and np.array_equal(new_b, self.boundaries)
@@ -657,6 +695,14 @@ class ShardedReplica:
         self.boundary_gen += 1
         self.boundary_moves += 1
         self._last_move = t
+        # boundary-aware result-capacity autotune: size the per-shard
+        # result slots from the POST-rebalance predicted per-shard
+        # load (recomputed only at moves — the value ships with the
+        # boundary broadcast, so every lockstep process builds the
+        # same shapes)
+        self.shard_results_effective = self._auto_shard_results(
+            keys, w, new_b
+        )
         with self._mu:
             for c in CLASSES:
                 self._force_major[c] = True
@@ -669,6 +715,44 @@ class ShardedReplica:
         )
         return True
 
+    def _auto_shard_results(
+        self, keys: np.ndarray, w: np.ndarray, boundaries
+    ) -> Optional[int]:
+        """Boundary-aware per-shard result capacity (ROADMAP PR 8
+        follow-up): the configured `shard_results` is the
+        BALANCED-load budget (e.g. the autotune profile's measured
+        hit-concentration base).  When the predicted per-shard load
+        share concentrates — exactly what a boundary move produces
+        when it isolates a hot range into one narrow shard — a query
+        over the hot range draws most of its hits from that one
+        shard, and a flat constant re-opens the result-slot
+        overflow -> exact-scan fallback the rebalance was meant to
+        kill.  Capacity therefore rises toward max_results in
+        proportion to the hottest shard's predicted load share (2x
+        safety), and never drops below the configured base.  Returns
+        None when no raise applies (unset base, or base already at
+        max_results)."""
+        base = self.shard_results
+        if base is None or base >= self.max_results:
+            return None
+        loads = self._predicted_shard_loads(keys, w, boundaries)
+        total = float(loads.sum())
+        if total <= 0:
+            return None
+        share = float(loads.max()) / total
+        need = int(np.ceil(self.max_results * min(1.0, 2.0 * share)))
+        return int(min(self.max_results, max(base, need)))
+
+    def _build_shard_results(self) -> Optional[int]:
+        """What ShardedDar builds actually use: the boundary-aware
+        effective capacity when a move computed one, else the
+        configured base."""
+        return (
+            self.shard_results
+            if self.shard_results_effective is None
+            else self.shard_results_effective
+        )
+
     @staticmethod
     def _equal_count_shards(n: int, n_sp: int) -> np.ndarray:
         ps = max((n + n_sp - 1) // n_sp, 8)
@@ -676,16 +760,22 @@ class ShardedReplica:
             np.arange(n, dtype=np.int64) // ps, n_sp - 1
         ).astype(np.int32)
 
-    def apply_boundaries(self, boundaries, bgen: int) -> None:
+    def apply_boundaries(self, boundaries, bgen: int,
+                         shard_results: Optional[int] = None) -> None:
         """Adopt a leader-broadcast boundary map (multihost follower
-        path): the split is applied verbatim — no local planning — so
-        every process builds identical shard rows for the identical
-        record prefix."""
+        path): the split — and the boundary-aware result capacity the
+        leader sized from the post-rebalance predicted load — is
+        applied verbatim, no local planning, so every process builds
+        identical shard rows (and identical result-slot shapes) for
+        the identical record prefix."""
         if bgen == self.boundary_gen:
             return
         self.boundaries = (
             None if boundaries is None
             else np.asarray(boundaries, np.int32)
+        )
+        self.shard_results_effective = (
+            None if shard_results is None else int(shard_results)
         )
         self.boundary_gen = int(bgen)
         self.boundary_moves += 1
@@ -703,7 +793,9 @@ class ShardedReplica:
         # EVERY process — incumbents and joiners then agree on bgen 0,
         # so the next broadcast bgen drives identical force-major
         # decisions everywhere); boundary_moves (the gauge) keeps
-        # counting
+        # counting.  The boundary-aware result capacity was sized for
+        # the dropped map — reset with it.
+        self.shard_results_effective = None
         self.boundary_gen = 0
         self._shard_hits_total = np.zeros(
             self.mesh.shape["sp"], np.int64
@@ -771,7 +863,7 @@ class ShardedReplica:
                         recs,
                         self.mesh,
                         max_results=self.max_results,
-                        shard_results=self.shard_results,
+                        shard_results=self._build_shard_results(),
                         boundaries=bounds,
                     )
                     if recs
@@ -797,7 +889,7 @@ class ShardedReplica:
                         drecs,
                         self.mesh,
                         max_results=self.max_results,
-                        shard_results=self.shard_results,
+                        shard_results=self._build_shard_results(),
                         boundaries=bounds,
                     )
                     if drecs
@@ -1114,6 +1206,13 @@ class ShardedReplica:
             "dss_shard_imbalance_factor": round(self._imbalance, 4),
             "dss_shard_boundary_moves": self.boundary_moves,
             "dss_shard_moved_bytes": self.moved_bytes,
+            # per-shard result capacity the builds actually use (the
+            # boundary-aware autotune raises it toward max_results
+            # when predicted load concentrates; 0 = legacy
+            # max_results-sized default)
+            "dss_shard_results_cap": int(
+                self._build_shard_results() or 0
+            ),
             "dss_shard_members": len(
                 {d.process_index for d in self.mesh.devices.flat}
             ),

@@ -116,6 +116,7 @@ def weighted_boundaries(
     post_key: np.ndarray,
     weights: Optional[np.ndarray],
     n_sp: int,
+    member_capacity: Optional[np.ndarray] = None,
 ) -> Optional[np.ndarray]:
     """Key-space split points equalizing predicted *query work* per
     shard (the searched-mapping step: placement driven by measured
@@ -133,12 +134,30 @@ def weighted_boundaries(
     postings array is rectangular, padded to the LARGEST shard — the
     cap bounds that memory/refresh-traffic blowup at 4x; indivisible
     single-key runs excepted).
+
+    `member_capacity` (optional, length n_sp) weighs each shard's
+    TARGET work by its host's measured serving capacity (the
+    `capacity_weight` scalar from per-host autotune profiles —
+    dss_tpu/plan/autotune.py): a slow host gets a proportionally
+    lighter key run.  None or a uniform vector reproduces the
+    equal-target split bit-identically.
     """
     pk = np.asarray(post_key, np.int32).ravel()
     pk = pk[pk != INT32_MAX]
     n = len(pk)
     if n == 0 or n_sp <= 1:
         return None
+    if member_capacity is None:
+        cap = np.ones(n_sp, np.float64)
+    else:
+        cap = np.asarray(member_capacity, np.float64).ravel()
+        if len(cap) != n_sp:
+            raise ValueError(
+                f"member_capacity has {len(cap)} entries for "
+                f"{n_sp} shards"
+            )
+        if not np.all(cap > 0):
+            raise ValueError("member_capacity entries must be > 0")
     w = np.ones(n, np.float64)
     if weights is not None:
         lw = np.asarray(weights, np.float64).ravel()
@@ -178,10 +197,18 @@ def weighted_boundaries(
         # would force some LATER shard (often the last) over it
         return (n - (consumed + extra)) <= (rem_sh - 1) * count_cap
 
+    def next_target() -> float:
+        # the shard being filled is bounds-index len(bounds); its
+        # target is its capacity's share of the remaining weight
+        # (uniform capacity: exactly rem_w / rem_sh, the historical
+        # equal-target split)
+        s = len(bounds)
+        return rem_w * float(cap[s]) / float(cap[s:].sum())
+
     for i in range(len(uk)):
         if len(bounds) == n_sp - 1:
             break
-        target = rem_w / rem_sh
+        target = next_target()
         if (
             acc > 0
             and (
@@ -201,7 +228,7 @@ def weighted_boundaries(
             acc_n = 0
             if len(bounds) == n_sp - 1:
                 break
-            target = rem_w / rem_sh
+            target = next_target()
         acc += float(run_w[i])
         acc_n += int(run_n[i])
         if acc >= target and i + 1 < len(uk) and fits_after_cut(acc_n):

@@ -1,0 +1,393 @@
+"""Offline mapping-space autotune: measured seeds for the planner.
+
+The PR 5/6 cost models converge online, but a fresh process pays the
+winsorized-EWMA learning window under live traffic: until enough
+batches have been observed, the router runs on the compiled-in
+defaults, which can be 10-100x off on a given host (a tunneled dev box
+vs an attached TPU differ by ~3 orders of magnitude on the dispatch
+floor).  The mapper papers in PAPERS.md (GOMA; data-placement
+evaluation of spatial accelerators) frame route x tile x batch choice
+as a *searched mapping* over an analytical cost model — and a
+searchable mapping can be tuned offline.
+
+This module runs measured microbenchmarks on the ACTUAL host — the
+same kernels the serving path runs, no synthetic proxies — and emits a
+machine-readable profile:
+
+    deploy/autotune/<host-class>.json
+
+that `cmds/server.py --autotune_profile` (or DSS_AUTOTUNE_PROFILE)
+loads at boot.  Knob precedence is env > profile > defaults: the
+profile seeds only knobs the operator has not explicitly set
+(os.environ.setdefault), so a deliberate override always wins.
+
+Measured quantities -> knobs:
+
+  host chunk scan cost        -> DSS_CO_EST_CHUNK_MS
+  cold dispatch floor + slope -> DSS_CO_EST_FLOOR_MS, DSS_CO_EST_ITEM_MS
+  resident stream gap/latency -> DSS_CO_EST_RES_FLOOR_MS, DSS_CO_EST_RES_LAT_MS
+  stream-depth knee           -> DSS_CO_RES_INFLIGHT, DSS_CO_RES_RING
+  AOT bucket grids            -> DSS_RES_BATCH_BUCKETS, DSS_RES_WINDOW_BUCKETS
+  per-query hit concentration -> DSS_SHARD_RESULTS (per-shard result
+                                 capacity base for the sharded replica)
+
+plus `capacity_weight`, this host's measured serving capacity scalar —
+the per-member capacity vector for `weighted_boundaries` is assembled
+from the member hosts' profiles (docs/OPERATIONS.md).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+PROFILE_FORMAT = 1
+PROFILE_DIR = os.path.join("deploy", "autotune")
+
+# every knob a profile may seed — apply_profile refuses to touch
+# anything else, so a stray profile cannot smuggle arbitrary env
+KNOB_KEYS = (
+    "DSS_CO_EST_FLOOR_MS",
+    "DSS_CO_EST_ITEM_MS",
+    "DSS_CO_EST_CHUNK_MS",
+    "DSS_CO_EST_RES_FLOOR_MS",
+    "DSS_CO_EST_RES_LAT_MS",
+    "DSS_CO_RES_INFLIGHT",
+    "DSS_CO_RES_RING",
+    "DSS_RES_BATCH_BUCKETS",
+    "DSS_RES_WINDOW_BUCKETS",
+    "DSS_SHARD_RESULTS",
+)
+
+HOUR = 3_600_000_000_000
+NOW = 1_700_000_000_000_000_000
+
+
+def host_class() -> str:
+    """Stable-ish identity of the machine class this profile was
+    measured on: accelerator platform + device kind + host core
+    count.  Two pods of the same shape share a profile; a laptop and
+    a TPU host never collide."""
+    try:
+        import jax
+
+        dev = jax.devices()[0]
+        plat = dev.platform
+        kind = getattr(dev, "device_kind", plat) or plat
+    except Exception:  # noqa: BLE001 — no runtime yet
+        plat, kind = "cpu", "host"
+    kind = "".join(
+        c if (c.isalnum() or c in "-_") else "-" for c in str(kind)
+    ).strip("-")
+    return f"{plat}-{kind}-c{os.cpu_count() or 1}"
+
+
+def default_profile_path(base: Optional[str] = None) -> str:
+    return os.path.join(base or PROFILE_DIR, f"{host_class()}.json")
+
+
+# -- fixture -------------------------------------------------------------------
+
+
+def _fixture(n_entities: int, n_cells: int, kpe: int = 8, seed: int = 0):
+    """A small dense synthetic DAR (same generator shape as bench.py's
+    build_table) — big enough that chunk scans and kernel costs are
+    representative, small enough to build in well under a second."""
+    from dss_tpu.dar.oracle import Record
+    from dss_tpu.dar.snapshot import DarTable
+
+    rng = np.random.default_rng(seed)
+    keys = np.sort(
+        rng.integers(0, n_cells, (n_entities, kpe)).astype(np.int32),
+        axis=1,
+    )
+    alt_lo = rng.uniform(0, 3000, n_entities).astype(np.float32)
+    alt_hi = alt_lo + rng.uniform(10, 600, n_entities).astype(np.float32)
+    t0 = NOW + rng.integers(-4, 4, n_entities) * HOUR
+    t1 = t0 + rng.integers(1, 6, n_entities) * HOUR
+    records = [
+        Record(
+            entity_id=f"e{i}",
+            keys=keys[i],
+            alt_lo=float(alt_lo[i]),
+            alt_hi=float(alt_hi[i]),
+            t_start=int(t0[i]),
+            t_end=int(t1[i]),
+            owner_id=i & 0xFFFF,
+        )
+        for i in range(n_entities)
+    ]
+    table = DarTable(delta_capacity=4096)
+    table.bulk_load(records)
+    return table
+
+
+def _query_batch(seed: int, batch: int, n_cells: int, width: int = 8):
+    r = np.random.default_rng(seed)
+    start = r.integers(0, max(1, n_cells - width), batch)
+    qkeys = (start[:, None] + np.arange(width)[None, :]).astype(np.int32)
+    alo = r.uniform(0, 3000, batch).astype(np.float32)
+    t0 = NOW + r.integers(-2, 2, batch) * HOUR
+    return (
+        qkeys,
+        alo,
+        (alo + 300.0).astype(np.float32),
+        t0.astype(np.int64),
+        (t0 + HOUR).astype(np.int64),
+    )
+
+
+def _median_ms(samples: List[float]) -> float:
+    return sorted(samples)[len(samples) // 2] * 1000.0
+
+
+# -- measurements --------------------------------------------------------------
+
+
+def measure_chunk_ms(ft, n_cells: int, *, reps: int = 5,
+                     batch: int = 256) -> float:
+    """One warmed-bucket exact host scan (the hostchunk route's unit
+    cost): a `batch`-query forced chunked scan, divided by its chunk
+    count.  Median over reps."""
+    qb = _query_batch(11, batch, n_cells)
+    chunks = -(-batch // ft.HOST_MAX_BATCH)
+    ft.query_host_chunked(*qb, now=NOW)  # warm the scan path
+    ts = []
+    for i in range(reps):
+        t0 = time.perf_counter()
+        ft.query_host_chunked(
+            qb[0], qb[1], qb[2], qb[3] + i, qb[4] + i, now=NOW
+        )
+        ts.append(time.perf_counter() - t0)
+    return _median_ms(ts) / chunks
+
+
+def measure_device(ft, n_cells: int, *, reps: int = 4,
+                   sizes=(128, 1024)) -> Dict[str, float]:
+    """Cold fused-kernel dispatch floor + per-item slope: synchronous
+    submit+collect at two batch sizes, two-point fit (the same model
+    the online EWMA converges to — floor = t1 - item*n1)."""
+    med = {}
+    for n in sizes:
+        qb = _query_batch(13 + n, n, n_cells)
+        ft.collect(ft.submit(*qb, now=NOW))  # warm the jit bucket
+        ts = []
+        for i in range(reps):
+            t0 = time.perf_counter()
+            ft.collect(
+                ft.submit(
+                    qb[0], qb[1], qb[2], qb[3] + i, qb[4] + i, now=NOW
+                )
+            )
+            ts.append(time.perf_counter() - t0)
+        med[n] = _median_ms(ts)
+    n1, n2 = min(sizes), max(sizes)
+    item = max(0.0, (med[n2] - med[n1]) / max(1, n2 - n1))
+    floor = max(0.05, med[n1] - item * n1)
+    return {
+        "floor_ms": floor,
+        "item_ms": item,
+        "batch_ms": {str(k): round(v, 3) for k, v in med.items()},
+    }
+
+
+def measure_resident(ft, n_cells: int, *, depths=(2, 4, 8),
+                     batch: int = 128,
+                     window_bucket: int = 256) -> Dict[str, object]:
+    """Resident stream: amortized per-batch gap at each stream depth
+    (submits issued back-to-back before any collect — the feeder
+    loop's steady state) + the single-batch submit->delivered latency.
+    The chosen DSS_CO_RES_INFLIGHT is the KNEE: the smallest depth
+    within 10% of the best amortized gap (a deeper stream buys nothing
+    but queue wait)."""
+    from dss_tpu.ops.resident import ResidentKernel
+
+    kern = ResidentKernel()
+    compile_t0 = time.perf_counter()
+    kern.warm(
+        ft, batch_buckets=(batch,), window_buckets=(window_bucket,)
+    )
+    compile_ms = (time.perf_counter() - compile_t0) * 1000.0
+    qb = _query_batch(17, batch, n_cells)
+    ft.collect(ft.submit(*qb, now=NOW, kernel=kern))  # warm
+
+    # single-batch latency through the resident executable
+    lat = []
+    for i in range(4):
+        t0 = time.perf_counter()
+        ft.collect(
+            ft.submit(
+                qb[0], qb[1], qb[2], qb[3] + i, qb[4] + i,
+                now=NOW, kernel=kern,
+            )
+        )
+        lat.append(time.perf_counter() - t0)
+    lat_ms = _median_ms(lat)
+
+    gaps = {}
+    for d in depths:
+        t0 = time.perf_counter()
+        pend = [
+            ft.submit(
+                qb[0], qb[1], qb[2], qb[3] + i, qb[4] + i,
+                now=NOW, kernel=kern,
+            )
+            for i in range(d)
+        ]
+        for p in pend:
+            ft.collect(p)
+        gaps[d] = (time.perf_counter() - t0) / d * 1000.0
+    best = min(gaps.values())
+    knee = next(d for d in sorted(gaps) if gaps[d] <= 1.1 * best)
+    return {
+        "gap_ms_by_depth": {str(d): round(g, 3) for d, g in gaps.items()},
+        "lat_ms": lat_ms,
+        "floor_ms": max(0.02, min(gaps.values())),
+        "inflight": int(knee),
+        "ring": int(min(128, max(16, 8 * knee))),
+        "aot_compile_ms": round(compile_ms, 1),
+    }
+
+
+def measure_hit_concentration(ft, n_cells: int, *, batch: int = 256,
+                              max_results: int = 512) -> Dict[str, int]:
+    """Per-query unique-hit distribution of the synthetic workload:
+    the base for the sharded replica's per-shard result capacity
+    (DSS_SHARD_RESULTS).  p99.9 x 2 headroom, clamped to
+    [16, max_results] — the boundary-aware autotune in
+    parallel/replica.py then raises it toward max_results whenever the
+    predicted per-shard load share concentrates (a hot move must not
+    re-open the overflow->exact-scan risk)."""
+    qb = _query_batch(19, batch, n_cells)
+    qidx, _slots = ft.query_fused(*qb, now=NOW)
+    per_q = np.bincount(np.asarray(qidx, np.int64), minlength=batch)
+    p999 = int(np.percentile(per_q, 99.9)) if len(per_q) else 0
+    rec = int(min(max_results, max(16, 2 * p999)))
+    return {
+        "hits_p50": int(np.percentile(per_q, 50)),
+        "hits_p999": p999,
+        "shard_results": rec,
+    }
+
+
+# -- the sweep -----------------------------------------------------------------
+
+
+def autotune(*, quick: bool = False, entities: Optional[int] = None,
+             cells: Optional[int] = None) -> dict:
+    """Run the measured sweep on this host and return a profile dict.
+
+    quick=True is the CI smoke grid: a tiny fixture, two stream
+    depths, minimal reps — deterministic shape, seconds of wall
+    clock.  The full sweep uses a denser fixture and deeper stream
+    ladder (still well under a minute on the dev box)."""
+    n_ent = entities or (2_000 if quick else 50_000)
+    n_cel = cells or (2_000 if quick else 20_000)
+    depths = (2, 4) if quick else (2, 4, 8, 16)
+    reps = 3 if quick else 6
+
+    t_all = time.perf_counter()
+    table = _fixture(n_ent, n_cel)
+    try:
+        ft = table._state.snap.fast
+        chunk_ms = measure_chunk_ms(ft, n_cel, reps=reps)
+        dev = measure_device(ft, n_cel, reps=max(3, reps - 2))
+        res = measure_resident(
+            ft, n_cel, depths=depths,
+            batch=128, window_bucket=256,
+        )
+        conc = measure_hit_concentration(ft, n_cel)
+    finally:
+        table.close()
+
+    # AOT bucket grids: resident batches land in pow2 buckets between
+    # the host cutoff and the AIMD max drain; window buckets cover the
+    # candidate windows the fixture actually produced, extended upward
+    # (bigger tables only grow the window).  The quick grid stays tiny
+    # so the smoke's warm pass is deterministic seconds, not minutes.
+    if quick:
+        batch_buckets = "128,512"
+        window_buckets = "256,4096"
+    else:
+        batch_buckets = "128,512,2048,4096"
+        window_buckets = "256,1024,4096,16384,65536"
+
+    knobs = {
+        "DSS_CO_EST_CHUNK_MS": round(chunk_ms, 4),
+        "DSS_CO_EST_FLOOR_MS": round(dev["floor_ms"], 3),
+        "DSS_CO_EST_ITEM_MS": round(dev["item_ms"], 5),
+        "DSS_CO_EST_RES_FLOOR_MS": round(res["floor_ms"], 3),
+        "DSS_CO_EST_RES_LAT_MS": round(res["lat_ms"], 3),
+        "DSS_CO_RES_INFLIGHT": res["inflight"],
+        "DSS_CO_RES_RING": res["ring"],
+        "DSS_RES_BATCH_BUCKETS": batch_buckets,
+        "DSS_RES_WINDOW_BUCKETS": window_buckets,
+        "DSS_SHARD_RESULTS": conc["shard_results"],
+    }
+    return {
+        "format": PROFILE_FORMAT,
+        "host_class": host_class(),
+        "quick": bool(quick),
+        "fixture": {"entities": n_ent, "cells": n_cel},
+        "sweep_s": round(time.perf_counter() - t_all, 2),
+        # this host's relative serving capacity (host-scan throughput
+        # in chunk-queries/ms): the per-member capacity vector for
+        # weighted_boundaries is assembled from member profiles
+        "capacity_weight": round(
+            64.0 / max(chunk_ms, 1e-3), 2
+        ),
+        "knobs": knobs,
+        "measurements": {
+            "chunk_ms": round(chunk_ms, 4),
+            "device": dev,
+            "resident": res,
+            "hit_concentration": conc,
+        },
+    }
+
+
+# -- persistence / boot application --------------------------------------------
+
+
+def save_profile(profile: dict, path: Optional[str] = None) -> str:
+    path = path or default_profile_path()
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(profile, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return path
+
+
+def load_profile(path: str) -> dict:
+    with open(path) as f:
+        profile = json.load(f)
+    if not isinstance(profile, dict) or "knobs" not in profile:
+        raise ValueError(f"{path}: not an autotune profile (no knobs)")
+    fmt = int(profile.get("format", 0))
+    if fmt > PROFILE_FORMAT:
+        raise ValueError(
+            f"{path}: profile format {fmt} is newer than this binary "
+            f"({PROFILE_FORMAT})"
+        )
+    return profile
+
+
+def apply_profile(profile: dict, env=None) -> Dict[str, str]:
+    """Seed serving knobs from a profile with env-over-profile
+    precedence: only UNSET variables are written (setdefault), so an
+    operator's explicit DSS_* override always wins, and only the
+    known KNOB_KEYS are ever touched.  Returns what was applied."""
+    env = os.environ if env is None else env
+    applied: Dict[str, str] = {}
+    for k, v in profile.get("knobs", {}).items():
+        if k not in KNOB_KEYS or k in env:
+            continue
+        env[k] = str(v)
+        applied[k] = str(v)
+    return applied

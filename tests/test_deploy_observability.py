@@ -76,6 +76,7 @@ def _exported_metric_names() -> set:
         "dss_shard_boundary_moves",
         "dss_shard_moved_bytes",
         "dss_shard_members",
+        "dss_shard_results_cap",
     }
     # tpu-storage DAR gauges (memory backend exports fewer)
     tpu = DSSStore(storage="tpu", clock=Clock())
@@ -217,6 +218,31 @@ def test_grafana_and_rules_cover_deadline_routing():
     }
     assert "DssDeadlineShedding" in alerts
     assert "co_deadline_shed" in alerts["DssDeadlineShedding"]
+
+
+def test_grafana_covers_planner_decision_mix():
+    """The query planner must stay observable: a dashboard panel over
+    the co_plan_* decision-mix counters (all six routes + ring-full
+    fallback demotions) and the boundary-aware result-capacity gauge."""
+    dash = json.load(
+        open(os.path.join(ROOT, "deploy/grafana/dss-dashboard.json"))
+    )
+    exprs = [
+        t["expr"]
+        for p in dash["panels"]
+        for t in p.get("targets", [])
+    ]
+    for needed in (
+        "co_plan_cache",
+        "co_plan_inline",
+        "co_plan_hostchunk",
+        "co_plan_device",
+        "co_plan_resident",
+        "co_plan_mesh",
+        "co_plan_fallbacks",
+        "dss_shard_results_cap",
+    ):
+        assert any(needed in e for e in exprs), needed
 
 
 def test_grafana_and_rules_cover_resident_kernel():

@@ -460,3 +460,237 @@ def test_uniform_load_never_moves_boundaries(tmp_path):
         assert rep.boundaries is None
     finally:
         rep.close()
+
+
+# -- heterogeneous capacity weights (PR 10 satellite) --------------------------
+
+
+def test_capacity_uniform_is_bit_identical():
+    """member_capacity=None, ones, and any uniform scale must all
+    produce EXACTLY the same split — heterogeneity only engages when
+    capacities actually differ."""
+    rng = np.random.default_rng(11)
+    pk, _ = _postings(rng)
+    w = np.asarray(rng.uniform(0, 5, len(pk)))
+    b0 = weighted_boundaries(pk, w, 8)
+    for cap in (np.ones(8), np.full(8, 3.7)):
+        b = weighted_boundaries(pk, w, 8, member_capacity=cap)
+        assert np.array_equal(b0, b)
+
+
+def test_capacity_slow_host_gets_lighter_key_run():
+    """A shard declared at quarter capacity ends up with a
+    proportionally lighter run; the fast shards absorb the rest."""
+    rng = np.random.default_rng(12)
+    pk, _ = _postings(rng, n=4000)
+    cap = np.array([1.0, 0.25, 1.0, 1.0])
+    b = weighted_boundaries(pk, None, 4, member_capacity=cap)
+    counts = np.bincount(shard_of_keys(pk, b, 4), minlength=4)
+    # the slow shard's run is well under the fast shards' (count
+    # baseline == the work here, so counts track assigned work)
+    assert counts[1] < 0.5 * counts[0]
+    assert counts[1] < 0.5 * counts[2]
+    # placement never changes answers: the boundaries are still a
+    # legal sorted split of the key space
+    assert np.all(np.diff(b) >= 0)
+
+
+def test_capacity_vector_validation(tmp_path):
+    rng = np.random.default_rng(13)
+    pk, _ = _postings(rng, n=500)
+    with pytest.raises(ValueError, match="entries for"):
+        weighted_boundaries(pk, None, 4, member_capacity=np.ones(3))
+    with pytest.raises(ValueError, match="> 0"):
+        weighted_boundaries(
+            pk, None, 4, member_capacity=np.array([1.0, 0.0, 1.0, 1.0])
+        )
+    # the replica rejects a bad vector at CONSTRUCTION, not at some
+    # later fold deep inside the leader's sync path
+    from dss_tpu.parallel.replica import ShardedReplica
+
+    wal = str(tmp_path / "capval.wal")
+    open(wal, "w").close()
+    mesh = make_mesh(8, dp=1, sp=8)
+    for bad in ([1.0] * 7 + [0.0], [1.0] * 7 + [float("nan")]):
+        with pytest.raises(ValueError, match="finite and > 0"):
+            ShardedReplica(mesh, wal_path=wal, capacity_weights=bad)
+
+
+def test_replica_capacity_normalized_hysteresis(tmp_path):
+    """Hysteresis runs on load/capacity: the same measured load that
+    is a hot spot on a homogeneous mesh is BALANCED when the loaded
+    shard is the high-capacity host (it is supposed to carry more)."""
+    rng = np.random.default_rng(14)
+    records = _mk_records(rng, 400)
+    base_load = RangeLoad(shift=3)
+    for _ in range(10):
+        base_load.record(
+            np.arange(0, 8000, 4, dtype=np.int32), work=1.0
+        )
+        # extra heat on the low key range (shard 0 under the
+        # equal-count split)
+        base_load.record(
+            np.arange(0, 900, 2, dtype=np.int32), work=4.0
+        )
+    # homogeneous: the low-range heat is a hot spot -> move
+    rep = _mk_replica(
+        tmp_path, records, "hom",
+        rebalance_ratio=1.5, move_interval_s=0.0,
+    )
+    try:
+        rep.load = base_load
+        # capacity vector provisioned to match the measured load (the
+        # operator put the big host where the load is): every shard
+        # then runs AT its capacity share — balanced by definition
+        keys = rep._all_posting_keys()
+        w = rep.load.weights_for(keys)
+        cur = rep._predicted_shard_loads(keys, w, None)
+        cap = cur / cur.min()
+        assert rep.plan_rebalance(now=10.0) is True
+        raw_imb = rep._imbalance
+        assert raw_imb > 1.5
+    finally:
+        rep.close()
+    # heterogeneous, hot shard IS the big host: normalized load is
+    # balanced -> strict no-op
+    rep2 = _mk_replica(
+        tmp_path, records, "het",
+        rebalance_ratio=1.5, move_interval_s=0.0,
+        capacity_weights=cap,
+    )
+    try:
+        rep2.load = base_load
+        assert rep2.plan_rebalance(now=10.0) is False
+        assert rep2._imbalance < raw_imb
+        assert rep2.boundary_moves == 0
+    finally:
+        rep2.close()
+
+
+# -- boundary-aware shard_results autotune (PR 10 satellite) -------------------
+
+
+def _hot_records(n_hot=120, n_cold=400, seed=21):
+    """n_hot records all covering ONE hot key (a mass-event box) plus
+    cold filler: a query over the hot key returns n_hot hits from
+    whichever single shard holds that key."""
+    rng = np.random.default_rng(seed)
+    recs = []
+    for i in range(n_hot):
+        recs.append(Record(
+            entity_id=f"hot{i}",
+            keys=np.asarray([5000], np.int32),
+            alt_lo=0.0, alt_hi=3000.0,
+            t_start=-(2**62), t_end=2**62, owner_id=0,
+        ))
+    # cold filler keyed BELOW the hot key so the hot query's answer
+    # is exactly the hot set
+    recs += _mk_records(rng, n_cold, key_space=4000, prefix="cold")
+    return recs
+
+
+def _one_query(rep, keys):
+    return rep.query_batch(
+        [np.asarray(keys, np.int32)],
+        np.full(1, -np.inf, np.float32),
+        np.full(1, np.inf, np.float32),
+        np.full(1, -(2**62), np.int64),
+        np.full(1, 2**62, np.int64),
+        now=0,
+        cls="isas",
+    )[0]
+
+
+def test_shard_results_raises_after_forced_hot_move(tmp_path):
+    """The PR 8 residual: a flat shard_results constant under-sizes
+    the post-move hot shard and every hot query overflows to the
+    exact-scan fallback.  The boundary-aware autotune sizes the
+    capacity from the post-rebalance predicted per-shard load, so the
+    same hot query fits in-slot."""
+    records = _hot_records()
+    # flat constant, no autotune baseline: the hot query overflows
+    flat = _mk_replica(tmp_path, records, "flat",
+                       rebalance_ratio=0.0, move_interval_s=0.0)
+    try:
+        assert flat.shard_results == 48  # the configured base
+        got_flat = _one_query(flat, [5000])
+        snap = flat._snapshots["isas"]
+        assert len(got_flat) == 120  # exact fallback keeps it correct
+        assert snap.base.overflow_fallbacks >= 1
+    finally:
+        flat.close()
+    # autotuned: force the hot move; the effective capacity must rise
+    # to cover the hot shard's predicted concentration
+    rep = _mk_replica(tmp_path, records, "auto",
+                      rebalance_ratio=1.2, move_interval_s=0.0)
+    try:
+        rep.load = RangeLoad(shift=3)
+        for _ in range(10):
+            rep.load.record(
+                np.arange(0, 8000, 8, dtype=np.int32), work=1.0
+            )
+        for _ in range(40):
+            rep.load.record(np.asarray([5000], np.int32), work=50.0)
+        assert rep.plan_rebalance(now=5.0) is True  # the forced move
+        assert rep.shard_results_effective is not None
+        assert rep.shard_results_effective > 48
+        rep.refresh(plan=False)
+        snap = rep._snapshots["isas"]
+        assert snap.base.shard_results == rep.shard_results_effective
+        before = snap.base.overflow_fallbacks
+        got = _one_query(rep, [5000])
+        assert sorted(got) == sorted(
+            [f"hot{i}" for i in range(120)]
+        ) == sorted(_one_query(rep, [5000]))
+        if rep.shard_results_effective >= 120:
+            # sized to cover the concentration: no overflow fallback
+            assert snap.base.overflow_fallbacks == before
+    finally:
+        rep.close()
+
+
+def test_shard_results_env_seed(tmp_path, monkeypatch):
+    """DSS_SHARD_RESULTS (the autotune profile's measured base) seeds
+    the replica's per-shard capacity when the constructor is silent;
+    an explicit constructor value still wins."""
+    from dss_tpu.parallel.replica import ShardedReplica
+
+    monkeypatch.setenv("DSS_SHARD_RESULTS", "96")
+    wal = str(tmp_path / "env.wal")
+    open(wal, "w").close()
+    mesh = make_mesh(8, dp=1, sp=8)
+    rep = ShardedReplica(mesh, wal_path=wal, max_results=256)
+    try:
+        assert rep.shard_results == 96
+    finally:
+        rep.close()
+    rep2 = ShardedReplica(
+        mesh, wal_path=wal, max_results=256, shard_results=40
+    )
+    try:
+        assert rep2.shard_results == 40
+    finally:
+        rep2.close()
+
+
+def test_apply_boundaries_adopts_broadcast_shard_results(tmp_path):
+    """Follower path: the leader-broadcast effective capacity is
+    adopted verbatim with the boundary map (identical result-slot
+    shapes on every lockstep process), and a reform drops both."""
+    rng = np.random.default_rng(30)
+    rep = _mk_replica(tmp_path, _mk_records(rng, 100), "fol")
+    try:
+        rep.apply_boundaries(
+            np.asarray([100, 200, 300, 400, 500, 600, 700], np.int32),
+            bgen=3, shard_results=200,
+        )
+        assert rep.shard_results_effective == 200
+        assert rep._build_shard_results() == 200
+        # same bgen re-broadcast: idempotent no-op
+        rep.apply_boundaries(None, bgen=3, shard_results=None)
+        assert rep.shard_results_effective == 200
+        rep.reset_boundaries()
+        assert rep.shard_results_effective is None
+        assert rep._build_shard_results() == 48
+    finally:
+        rep.close()
