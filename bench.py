@@ -1347,6 +1347,593 @@ def resident_smoke_leg():
     )
 
 
+# -- chaos: deterministic fault injection + degradation ladder ----------------
+
+
+def _chaos_free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _chaos_wait_http(url: str, deadline_s: float = 30.0):
+    import requests
+
+    end = time.time() + deadline_s
+    last = None
+    while time.time() < end:
+        try:
+            r = requests.get(url, timeout=2)
+            if r.status_code < 500:
+                return r
+            last = r.status_code
+        except Exception as e:  # noqa: BLE001 — still booting
+            last = e
+        time.sleep(0.1)
+    raise RuntimeError(f"server at {url} never came up ({last})")
+
+
+def chaos_smoke_leg():
+    """CI chaos smoke (`bench.py --leg chaos-smoke`): the deterministic
+    device-loss scenario through the real store.  A seeded FaultPlan
+    kills the device at the dispatch seam mid-burst; the acceptance
+    contract is asserted end to end — the planner serves every search
+    via the host class (hostchunk plans, zero device plans beyond the
+    absorbed batch), ZERO unexpected 5xx (any shed is 429/503 WITH
+    Retry-After), the degradation ladder reads DEVICE_LOST, and after
+    fault clearance + recovery the answers are bit-identical to the
+    no-fault oracle with the device class re-admitted.  Exits nonzero
+    on any miss."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from dss_tpu import chaos
+
+    chaos.clear_plan()
+    chaos.registry().reset_counters()
+    store, areas, (t0, _t1), _versions = _poll_store(
+        n_isas=64, n_areas=8, cells_per_area=32, storage="tpu"
+    )
+    try:
+        def ids(area):
+            return sorted(
+                x.id for x in store.rid.search_isas(area, t0, None)
+            )
+
+        # the no-fault oracle
+        oracle = [ids(a) for a in areas]
+        assert any(oracle), "poll areas unexpectedly empty"
+        # every search must traverse the coalescer during the fault
+        # window: no cache hits, no lone-caller inline shortcut — the
+        # drained batches are what the planner routes
+        store.configure_serving(cache=False, inline=False)
+        co = store.rid._isa_index.coalescer
+        pre = co.stats()
+
+        chaos.install_plan(
+            {"seed": 1, "events": [
+                {"site": "device.dispatch", "action": "device_lost",
+                 "count": 1},
+            ]}
+        )
+        t_fault = time.perf_counter()
+        served = 0
+        shed_with_retry_after = 0
+        unexpected_5xx = 0
+
+        def one(k):
+            nonlocal served, shed_with_retry_after, unexpected_5xx
+            i = k % len(areas)
+            try:
+                got = ids(areas[i])
+            except errors.StatusError as e:
+                if (
+                    e.http_status in (429, 503)
+                    and getattr(e, "retry_after_s", None)
+                ):
+                    shed_with_retry_after += 1
+                    return
+                unexpected_5xx += 1
+                return
+            assert got == oracle[i], (i, got, oracle[i])
+            served += 1
+
+        with ThreadPoolExecutor(max_workers=16) as pool:
+            list(pool.map(one, range(96)))
+        mid = co.stats()
+        assert unexpected_5xx == 0, (
+            f"{unexpected_5xx} unexpected 5xx under device loss"
+        )
+        assert served >= 1
+        assert store.health.is_active("device_lost"), (
+            "ladder never flipped DEVICE_LOST"
+        )
+        assert mid["co_device_loss_absorbed"] >= 1, mid
+        assert mid["co_device_ok"] == 0, mid
+        host_plans = (
+            mid["co_plan_hostchunk"] - pre["co_plan_hostchunk"]
+        )
+        dev_plans = mid["co_plan_device"] - pre["co_plan_device"]
+        assert host_plans >= 1, (
+            f"device loss never exercised hostchunk plans: {mid}"
+        )
+        # at most the one absorbed batch ever planned the device
+        assert dev_plans <= 1, (pre, mid)
+        injected = chaos.registry().injected_by_site()
+        assert injected.get("device.dispatch", 0) == 1, injected
+        dwell_s = store.health.dwell_s("device_lost")
+        burn = unexpected_5xx / max(
+            1, served + shed_with_retry_after + unexpected_5xx
+        )
+
+        # fault clearance + recovery: re-warm runs before re-admission
+        chaos.clear_plan()
+        t_rec = time.perf_counter()
+        store.health.exit("device_lost")
+        assert co.stats()["co_device_ok"] == 1, "device not re-admitted"
+        store.configure_serving(cache=True, inline=True)
+        for i, a in enumerate(areas):
+            got = ids(a)
+            assert got == oracle[i], (
+                f"post-recovery divergence on area {i}: "
+                f"{got} != {oracle[i]}"
+            )
+        recovery_s = time.perf_counter() - t_rec
+        assert store.health.mode() == chaos.HEALTHY
+    finally:
+        chaos.clear_plan()
+        chaos.registry().reset_counters()
+        store.close()
+    print(
+        json.dumps(
+            {
+                "metric": "chaos_smoke",
+                "value": 1,
+                "unit": "ok",
+                "detail": {
+                    "served_during_loss": served,
+                    "shed_with_retry_after": shed_with_retry_after,
+                    "unexpected_5xx": unexpected_5xx,
+                    "error_budget_burn": round(burn, 4),
+                    "hostchunk_plans_during_loss": host_plans,
+                    "device_plans_during_loss": dev_plans,
+                    "degraded_dwell_s": round(dwell_s, 3),
+                    "recovery_to_identical_s": round(recovery_s, 3),
+                    "fault_window_s": round(
+                        time.perf_counter() - t_fault, 3
+                    ),
+                },
+            }
+        )
+    )
+    return 0
+
+
+def _chaos_device_lost_mid_stream() -> dict:
+    """Named scenario: the resident stream loses its device with
+    batches in flight.  Every admitted caller still resolves with the
+    right answer (host re-run), the ladder flips, and recovery
+    re-warms the AOT grid before the stream serves again."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from dss_tpu import chaos
+
+    chaos.clear_plan()
+    chaos.registry().reset_counters()
+    n_cells = 500
+    width = 4
+    table = build_table(2000, n_cells, 4)
+    co = QueryCoalescer(
+        table, min_batch=1, max_batch=256, inline=False, queue_depth=64,
+        slo_ms=0.0, resident=True,
+        est_floor_ms=10_000.0, est_res_floor_ms=0.05, est_chunk_ms=1e6,
+    )
+    lad = chaos.DegradationLadder()
+    co.set_health(lad)
+    loop = co.resident_loop()
+    table.warm_resident(
+        loop.kernel, batch_buckets=(16, 32, 64, 128),
+        window_buckets=(256, 1024),
+    )
+    starts = np.random.default_rng(3).integers(0, n_cells - width, 256)
+
+    def one(i):
+        keys = (
+            int(starts[i % len(starts)]) + np.arange(width)
+        ).astype(np.int32)
+        return keys, co.query(
+            keys, None, None, NOW - HOUR, NOW + HOUR, now=NOW
+        )
+
+    def check(pairs):
+        for keys, res in pairs:
+            ref = table.query(
+                keys, None, None, NOW - HOUR, NOW + HOUR, now=NOW
+            )
+            assert res == ref, f"divergence: {res} != {ref}"
+
+    try:
+        with ThreadPoolExecutor(max_workers=16) as pool:
+            warm = list(pool.map(one, range(64)))
+        check(warm)
+        st0 = co.stats()
+        assert st0["co_route_resident_batches"] >= 1, st0
+
+        chaos.install_plan(
+            {"seed": 2, "events": [
+                {"site": "resident.submit", "action": "device_lost",
+                 "count": 1},
+                {"site": "device.dispatch", "action": "device_lost",
+                 "count": 1},
+            ]}
+        )
+        with ThreadPoolExecutor(max_workers=16) as pool:
+            during = list(pool.map(one, range(128)))
+        check(during)  # zero errors, zero divergence through the loss
+        assert lad.is_active("device_lost")
+        st1 = co.stats()
+        assert st1["co_device_loss_absorbed"] >= 1, st1
+        dwell_s = lad.dwell_s("device_lost")
+
+        chaos.clear_plan()
+        t_rec = time.perf_counter()
+        lad.exit("device_lost")
+        with ThreadPoolExecutor(max_workers=16) as pool:
+            after = list(pool.map(one, range(64)))
+        check(after)
+        recovery_s = time.perf_counter() - t_rec
+        st2 = co.stats()
+        assert (
+            st2["co_route_resident_batches"]
+            > st1["co_route_resident_batches"]
+        ), "stream never re-admitted after recovery"
+        injected = chaos.registry().injected_by_site()
+        return {
+            "ok": True,
+            "absorbed": st1["co_device_loss_absorbed"],
+            "degraded_dwell_s": round(dwell_s, 3),
+            "recovery_to_slo_s": round(recovery_s, 3),
+            "error_budget_burn": 0.0,
+            "injected": injected,
+        }
+    finally:
+        chaos.clear_plan()
+        chaos.registry().reset_counters()
+        co.close()
+        table.close()
+
+
+def _chaos_wal_fsync_stall(tmpdir: str) -> dict:
+    """Named scenario: the WAL's fsync stalls (slow disk).  Writes pay
+    the stall honestly (latency, not loss); after the stall clears,
+    a fresh boot replays EVERY acked write."""
+    import uuid as _uuid
+    from datetime import datetime, timedelta, timezone
+
+    from dss_tpu import chaos
+    from dss_tpu.dar.dss_store import DSSStore
+    from dss_tpu.geo.s2cell import dar_key_to_cell
+    from dss_tpu.models import rid as ridm
+
+    chaos.clear_plan()
+    chaos.registry().reset_counters()
+    path = os.path.join(tmpdir, "chaos_wal.log")
+    store = DSSStore(storage="memory", wal_path=path, wal_fsync=True)
+    t0 = datetime.now(timezone.utc) + timedelta(minutes=5)
+    t1 = t0 + timedelta(hours=24)
+
+    def put(k):
+        isa = ridm.IdentificationServiceArea(
+            id=str(_uuid.UUID(int=k + 1, version=4)), owner="bench",
+            url="https://uss.example/flights",
+            cells=dar_key_to_cell(
+                np.arange(k * 4, (k + 1) * 4, dtype=np.int64)
+            ),
+            start_time=t0, end_time=t1,
+            altitude_lo=0.0, altitude_hi=3000.0,
+        )
+        t = time.perf_counter()
+        assert store.rid.insert_isa(isa) is not None
+        return (time.perf_counter() - t) * 1000
+
+    try:
+        base = [put(k) for k in range(40)]
+        chaos.install_plan(
+            {"seed": 4, "events": [
+                {"site": "wal.fsync", "action": "delay",
+                 "delay_s": 0.02, "count": -1},
+            ]}
+        )
+        stalled = [put(k) for k in range(40, 80)]
+        injected = chaos.registry().injected_by_site().get("wal.fsync", 0)
+        chaos.clear_plan()
+    finally:
+        chaos.clear_plan()
+        store.close()
+    # zero acked-write loss: a fresh boot replays everything
+    re = DSSStore(storage="memory", wal_path=path)
+    replayed = len(re.rid._isas)
+    re.close()
+    chaos.registry().reset_counters()
+    assert replayed == 80, f"acked-write loss: {replayed}/80 after replay"
+    p50 = lambda xs: float(np.percentile(xs, 50))  # noqa: E731
+    assert injected >= 40
+    assert p50(stalled) > p50(base), (
+        "stall never showed in write latency"
+    )
+    return {
+        "ok": True,
+        "write_p50_ms_clean": round(p50(base), 3),
+        "write_p50_ms_stalled": round(p50(stalled), 3),
+        "write_p99_ms_stalled": round(float(np.percentile(stalled, 99)), 3),
+        "acked_writes_after_replay": replayed,
+        "fsync_stalls_injected": injected,
+    }
+
+
+def _chaos_region_partition(tmpdir: str) -> dict:
+    """Named scenario: the region log partitions away from this
+    instance.  Writes shed 503 with an honest Retry-After (breaker
+    cooldown), reads keep serving the stale-but-consistent state with
+    the mode surfaced, and the ladder walks back down on its own once
+    the link heals (the tail poller's first success)."""
+    import subprocess
+    import sys
+    import uuid as _uuid
+    from datetime import datetime, timedelta, timezone
+
+    from dss_tpu import chaos
+    from dss_tpu.dar.dss_store import DSSStore
+    from dss_tpu.geo.s2cell import dar_key_to_cell
+    from dss_tpu.models import rid as ridm
+
+    chaos.clear_plan()
+    chaos.registry().reset_counters()
+    port = _chaos_free_port()
+    url = f"http://127.0.0.1:{port}"
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "dss_tpu.cmds.region_server",
+            "--addr", f"127.0.0.1:{port}",
+            "--wal_path", os.path.join(tmpdir, "region.wal"),
+        ],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    store = None
+    try:
+        _chaos_wait_http(url + "/status")
+        store = DSSStore(storage="memory", region_url=url)
+        t0 = datetime.now(timezone.utc) + timedelta(minutes=5)
+        t1 = t0 + timedelta(hours=24)
+
+        def put(k):
+            isa = ridm.IdentificationServiceArea(
+                id=str(_uuid.UUID(int=k + 1, version=4)), owner="bench",
+                url="https://uss.example/flights",
+                cells=dar_key_to_cell(
+                    np.arange(k * 4, (k + 1) * 4, dtype=np.int64)
+                ),
+                start_time=t0, end_time=t1,
+                altitude_lo=0.0, altitude_hi=3000.0,
+            )
+            return store.rid.insert_isa(isa)
+
+        for k in range(5):
+            assert put(k) is not None
+        area = dar_key_to_cell(np.arange(0, 4, dtype=np.int64))
+        pre_reads = sorted(
+            x.id for x in store.rid.search_isas(area, t0, None)
+        )
+        assert pre_reads
+
+        # PARTITION: every region-log request fails at the transport
+        chaos.install_plan(
+            {"seed": 6, "events": [
+                {"site": "region.client.request",
+                 "action": "partition", "count": -1},
+            ]}
+        )
+        shed = None
+        try:
+            put(100)
+        except errors.StatusError as e:
+            shed = e
+        assert shed is not None and shed.http_status == 503, shed
+        retry_after = getattr(shed, "retry_after_s", None)
+        assert retry_after and retry_after > 0, (
+            "region-down 503 carried no Retry-After"
+        )
+        assert store.health.is_active("region_log_down")
+        assert (
+            store.freshness_status()["degraded_mode"]
+            == "region_log_down"
+        )
+        # reads keep serving the fenced stale-but-consistent state
+        during_reads = sorted(
+            x.id for x in store.rid.search_isas(area, t0, None)
+        )
+        assert during_reads == pre_reads
+        breakers = store.stats()["dss_breaker_state"]
+        assert any(v == 2 for v in breakers.values()), breakers
+
+        # HEAL: the tail poller's first success exits the condition;
+        # writes resume
+        chaos.clear_plan()
+        t_rec = time.perf_counter()
+        deadline = t_rec + 30.0
+        wrote = False
+        while time.perf_counter() < deadline:
+            try:
+                if put(101) is not None:
+                    wrote = True
+                    break
+            except errors.StatusError:
+                time.sleep(0.2)
+        assert wrote, "writes never recovered after the partition healed"
+        recovery_s = time.perf_counter() - t_rec
+        deadline = time.perf_counter() + 10.0
+        while (
+            store.health.mode() != chaos.HEALTHY
+            and time.perf_counter() < deadline
+        ):
+            time.sleep(0.05)
+        assert store.health.mode() == chaos.HEALTHY
+        return {
+            "ok": True,
+            "write_shed_status": shed.http_status,
+            "write_shed_retry_after_s": round(retry_after, 3),
+            "reads_served_during_partition": len(during_reads),
+            "degraded_dwell_s": round(
+                store.health.dwell_s("region_log_down"), 3
+            ),
+            "recovery_to_first_write_s": round(recovery_s, 3),
+        }
+    finally:
+        chaos.clear_plan()
+        chaos.registry().reset_counters()
+        if store is not None:
+            store.close()
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except Exception:  # noqa: BLE001
+            proc.kill()
+
+
+def _chaos_mirror_link_flap(tmpdir: str) -> dict:
+    """Named scenario: the primary->mirror replication link flaps
+    (drops, then delays).  The fault plan ships via DSS_FAULT_PLAN in
+    the PRIMARY process's environment — the cross-process injection
+    path operators use.  The flap is visible in
+    region_mirror_backoff_s BEFORE lag accumulates, and the mirror
+    converges to the full head once the link heals."""
+    import subprocess
+    import sys
+
+    import requests
+
+    pport, mport = _chaos_free_port(), _chaos_free_port()
+    purl = f"http://127.0.0.1:{pport}"
+    murl = f"http://127.0.0.1:{mport}"
+    plan = json.dumps(
+        {"seed": 3, "events": [
+            {"site": "region.mirror.replicate", "match": "/replicate",
+             "action": "error", "count": 8},
+            {"site": "region.mirror.replicate", "match": "/replicate",
+             "action": "delay", "delay_s": 0.15, "after": 8,
+             "count": 12},
+        ]}
+    )
+    primary = subprocess.Popen(
+        [
+            sys.executable, "-m", "dss_tpu.cmds.region_server",
+            "--addr", f"127.0.0.1:{pport}",
+            "--wal_path", os.path.join(tmpdir, "flap_p.wal"),
+        ],
+        env=dict(os.environ, DSS_FAULT_PLAN=plan, JAX_PLATFORMS="cpu"),
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    mirror = subprocess.Popen(
+        [
+            sys.executable, "-m", "dss_tpu.cmds.region_server",
+            "--addr", f"127.0.0.1:{mport}",
+            "--wal_path", os.path.join(tmpdir, "flap_m.wal"),
+            "--mirror_of", purl,
+            "--advertise_url", murl,
+        ],
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    try:
+        _chaos_wait_http(purl + "/status")
+        _chaos_wait_http(murl + "/status")
+        from dss_tpu.region.client import RegionClient
+
+        c = RegionClient(purl, "chaos-bench")
+        n = 12
+        for i in range(4):
+            tok, _ = c.acquire_lease()
+            c.append(tok, [{"t": "e", "i": i}], release=True)
+
+        # the flap must be VISIBLE while it happens: poll the backoff
+        # gauge during the drop window
+        backoff_seen = 0.0
+        deadline = time.time() + 8.0
+        while time.time() < deadline and backoff_seen == 0.0:
+            text = requests.get(purl + "/metrics", timeout=5).text
+            for line in text.splitlines():
+                if line.startswith("region_mirror_backoff_s"):
+                    backoff_seen = max(
+                        backoff_seen, float(line.split()[-1])
+                    )
+            time.sleep(0.02)
+        assert backoff_seen > 0.0, (
+            "flap never visible in region_mirror_backoff_s"
+        )
+        for i in range(4, n):
+            tok, _ = c.acquire_lease()
+            c.append(tok, [{"t": "e", "i": i}], release=True)
+
+        # after the seeded plan exhausts, the link heals and the
+        # mirror converges to the full head
+        t_rec = time.time()
+        deadline = time.time() + 60.0
+        lag = None
+        while time.time() < deadline:
+            st = requests.get(purl + "/status", timeout=5).json()
+            lag = st["lag_entries"]
+            if st["mirrors"] and lag == 0:
+                break
+            time.sleep(0.2)
+        assert lag == 0, f"mirror never converged (lag={lag})"
+        mh = requests.get(murl + "/status", timeout=5).json()["head"]
+        assert mh == n, f"mirror head {mh} != {n} after recovery"
+        return {
+            "ok": True,
+            "entries": n,
+            "max_backoff_seen_s": round(backoff_seen, 3),
+            "converge_after_heal_s": round(time.time() - t_rec, 3),
+        }
+    finally:
+        for p in (primary, mirror):
+            p.terminate()
+        for p in (primary, mirror):
+            try:
+                p.wait(timeout=10)
+            except Exception:  # noqa: BLE001
+                p.kill()
+
+
+def chaos_leg():
+    """`bench.py --leg chaos`: the four named fault scenarios, each a
+    seeded, replayable schedule — device-lost-mid-stream,
+    WAL-fsync-stall, region-partition, mirror-link-flap — reporting
+    error-budget burn, degraded-mode dwell time, and recovery time.
+    One JSON line; nonzero exit if any scenario's contract breaks."""
+    import tempfile
+
+    detail = {}
+    with tempfile.TemporaryDirectory(prefix="dss-chaos-") as tmpdir:
+        detail["device-lost-mid-stream"] = _chaos_device_lost_mid_stream()
+        detail["wal-fsync-stall"] = _chaos_wal_fsync_stall(tmpdir)
+        detail["region-partition"] = _chaos_region_partition(tmpdir)
+        detail["mirror-link-flap"] = _chaos_mirror_link_flap(tmpdir)
+    print(
+        json.dumps(
+            {
+                "metric": "chaos",
+                "value": len(detail),
+                "unit": "scenarios_ok",
+                "detail": detail,
+            }
+        )
+    )
+    return 0
+
+
 def _skew_reexec(leg: str):
     """The skew legs need the dp=1 x sp=8 virtual CPU mesh; when this
     process's jax backend has fewer devices (the north-star run on a
@@ -2080,7 +2667,8 @@ def main():
         "--leg",
         choices=["north-star", "workers", "curve-smoke",
                  "resident-smoke", "poll", "cache-smoke", "skew",
-                 "skew-smoke", "autotune", "autotune-smoke"],
+                 "skew-smoke", "autotune", "autotune-smoke",
+                 "chaos", "chaos-smoke"],
         default="north-star",
         help="'north-star': the headline SCD conflict-qps benchmark "
         "(default); 'workers': multi-worker HTTP serving scaling smoke "
@@ -2104,7 +2692,13 @@ def main():
         "comparison (profile-seeded boot vs default seeds); "
         "'autotune-smoke': tiny deterministic grid, route "
         "reachability + live co_plan_* counters + real-binary boot "
-        "with the emitted profile (CI plan-smoke job)",
+        "with the emitted profile (CI plan-smoke job); 'chaos': the "
+        "four named seeded fault scenarios (device-lost-mid-stream, "
+        "wal-fsync-stall, region-partition, mirror-link-flap) "
+        "reporting error-budget burn, degraded-mode dwell, and "
+        "recovery time; 'chaos-smoke': deterministic device-loss CI "
+        "scenario — hostchunk serving under loss, zero unexpected "
+        "5xx, bit-identical answers after recovery",
     )
     args = ap.parse_args()
     if args.leg == "workers":
@@ -2126,6 +2720,10 @@ def main():
         return 0
     if args.leg == "autotune-smoke":
         return autotune_smoke_leg()
+    if args.leg == "chaos":
+        return chaos_leg()
+    if args.leg == "chaos-smoke":
+        return chaos_smoke_leg()
 
     n_entities = int(os.environ.get("DSS_BENCH_ENTITIES", 1_000_000))
     n_cells = int(os.environ.get("DSS_BENCH_CELLS", 200_000))

@@ -241,18 +241,36 @@ def _freshness_json_response(request, data) -> web.Response:
     """json_response carrying the X-DSS-Freshness header when the
     service call left a note: region epoch + DAR write generation +
     cache hit/miss, so operators can verify the version fence from
-    the wire without reading code."""
+    the wire without reading code.  When the store's degradation
+    ladder is non-healthy the header additionally carries
+    `;mode=<condition>` — a degraded answer (hostchunk-only serving,
+    fenced-cache reads during a region outage) is honest about it."""
     note = request.get("dss_freshness")
     headers = None
     if note is not None:
-        headers = {
-            "X-DSS-Freshness": (
-                f"epoch={note['epoch'] or '-'};"
-                f"class={note['cls']};gen={note['gen']};"
-                f"cache={'hit' if note['hit'] else 'miss'}"
-            )
-        }
+        val = (
+            f"epoch={note['epoch'] or '-'};"
+            f"class={note['cls']};gen={note['gen']};"
+            f"cache={'hit' if note['hit'] else 'miss'}"
+        )
+        health_fn = request.app.get("dss_health_fn")
+        if health_fn is not None:
+            try:
+                mode = health_fn()
+            except Exception:  # noqa: BLE001 — header is best-effort
+                mode = None
+            if mode and mode != "healthy":
+                val += f";mode={mode}"
+        headers = {"X-DSS-Freshness": val}
     return web.json_response(data, headers=headers)
+
+
+# dict-valued store stats render as labeled gauge families; the label
+# name is per-metric (everything else is the shard family)
+_GAUGE_VEC_LABELS = {
+    "dss_breaker_state": "remote",
+    "dss_fault_injected_total": "site",
+}
 
 
 # Routes a read-worker serves from its local WAL-tail replica; every
@@ -395,6 +413,7 @@ def build_app(
     dump_requests: bool = False,
     stats_fn=None,
     status_fn=None,  # freshness introspection: DSSStore.freshness_status
+    health_fn=None,  # degradation mode: DSSStore.health.mode_name
     default_timeout_s: float = 10.0,
     replica=None,  # ShardedOpReplica: multi-chip read-replica surface
     trace_requests: bool = False,
@@ -422,6 +441,10 @@ def build_app(
     app = web.Application(middlewares=middlewares)
     if worker_proxy is not None and hasattr(worker_proxy, "on_cleanup"):
         app.on_cleanup.append(worker_proxy.on_cleanup)
+    if health_fn is not None:
+        # the degradation-ladder mode: read by _freshness_json_response
+        # so degraded answers carry `;mode=...` in X-DSS-Freshness
+        app["dss_health_fn"] = health_fn
 
     async def _call_read(request, fn, *args):
         """Service call for READ handlers.  With inline_reads (single-
@@ -529,9 +552,14 @@ def build_app(
                 stats = await _call_r(request, stats_fn)
                 for name, val in stats.items():
                     if isinstance(val, dict):
-                        # per-shard (or other keyed) gauge families —
-                        # e.g. dss_shard_load{shard="3"}
-                        metrics.set_gauge_vec(name, "shard", val)
+                        # keyed gauge families — dss_shard_load{shard},
+                        # dss_breaker_state{remote},
+                        # dss_fault_injected_total{site}
+                        metrics.set_gauge_vec(
+                            name,
+                            _GAUGE_VEC_LABELS.get(name, "shard"),
+                            val,
+                        )
                     else:
                         metrics.set_gauge(name, val)
             return web.Response(

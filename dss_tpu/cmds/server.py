@@ -333,6 +333,7 @@ def build_worker(args) -> web.Application:
         dump_requests=args.dump_requests,
         stats_fn=stats_fn,
         status_fn=store.freshness_status,
+        health_fn=store.health.mode_name,
         default_timeout_s=args.default_timeout,
         trace_requests=args.trace_requests,
         inline_reads=_inline_reads(args),
@@ -498,6 +499,16 @@ def build(args) -> web.Application:
     metrics.set_info("dss_build_info", build_info())
 
     mh_runtime = getattr(args, "_mh_runtime", None)
+    if mh_runtime is not None:
+        # peer loss climbs the degradation ladder: the mesh route is
+        # already refused via replica freshness, this makes the mode
+        # explicit stack-wide (/status, X-DSS-Freshness, the
+        # dss_degraded_mode gauge + DssDegradedMode alert)
+        mh_runtime.on_degraded(
+            lambda: store.health.enter(
+                "mesh_degraded", mh_runtime.degraded_reason
+            )
+        )
     replica = None
     if args.sharded_replica:
         import jax
@@ -613,6 +624,7 @@ def build(args) -> web.Application:
         dump_requests=args.dump_requests,
         stats_fn=stats_fn,
         status_fn=store.freshness_status,
+        health_fn=store.health.mode_name,
         default_timeout_s=args.default_timeout,
         replica=replica,
         trace_requests=args.trace_requests,

@@ -99,7 +99,7 @@ from typing import List, Optional
 
 import numpy as np
 
-from dss_tpu import errors
+from dss_tpu import chaos, errors
 from dss_tpu.dar import budget
 from dss_tpu.dar import deadline as _deadline
 from dss_tpu.obs import stages as _stages
@@ -376,6 +376,8 @@ class QueryCoalescer:
         self._stat_route_hostchunk = 0  # of those: forced chunked route
         self._stat_route_device = 0  # batches that touched the device
         self._stat_route_resident = 0  # batches via the resident loop
+        self._stat_device_loss_absorbed = 0  # device-loss batches
+        #   re-served on the host instead of erroring callers
         self._stat_pack_ms = 0.0
         self._stat_device_ms = 0.0
         self._stat_collect_ms = 0.0
@@ -398,6 +400,11 @@ class QueryCoalescer:
         self._mesh_bgen = None  # replica boundary-generation getter:
         #   plans record WHICH shard placement they were made against
         self.mesh_offloads = 0
+        # optional degradation ladder (chaos.DegradationLadder): when
+        # attached, device-loss failures flip DEVICE_LOST (the planner
+        # stops admitting device-class routes) and the failed batch is
+        # re-served on the host — no caller ever sees the loss
+        self._health = None
 
     def _make_resident_loop(self):
         """Create (once) the resident device-feeder loop and install
@@ -435,6 +442,54 @@ class QueryCoalescer:
     def resident_loop(self):
         """The attached ResidentLoop, or None (boot warm + tests)."""
         return self._res_loop
+
+    def set_health(self, ladder) -> None:
+        """Attach the store's degradation ladder (dss_store wiring):
+        the planner reads device_ok from it and device-loss failures
+        report into it."""
+        self._health = ladder
+
+    def _device_ok(self) -> bool:
+        h = self._health
+        return True if h is None else h.device_ok()
+
+    def _absorb_device_loss(self, e: BaseException) -> bool:
+        """Is `e` a device loss this pipeline should absorb (report
+        DEVICE_LOST to the ladder, re-serve the batch on the host)
+        instead of delivering to callers?"""
+        if not chaos.is_device_loss(e):
+            return False
+        with self._slock:
+            self._stat_device_loss_absorbed += 1
+        if self._health is not None:
+            self._health.enter("device_lost", reason=str(e))
+        return True
+
+    def _host_rerun(self, batch: List[_Item]) -> None:
+        """Serve a device-failed batch via forced host chunks — the
+        pure-host path (FastTable.query_host_chunked), so a lost
+        device costs latency, never correctness or a caller 5xx."""
+        try:
+            keys, lo, hi, t0s, t1s, now, owners = self._pack_args(batch)
+            submit = getattr(self._table, "query_many_submit", None)
+            if submit is not None:
+                pq = submit(
+                    keys, lo, hi, t0s, t1s, now=now, owner_ids=owners,
+                    host_route=True,
+                )
+                self._deliver_results(
+                    batch, self._table.query_many_collect(pq)
+                )
+            else:
+                self._deliver_results(
+                    batch,
+                    self._table.query_many(
+                        keys, lo, hi, t0s, t1s, now=now,
+                        owner_ids=owners, host_route=True,
+                    ),
+                )
+        except BaseException as e:  # noqa: BLE001 — deliver to callers
+            self._deliver_error(batch, e)
 
     def set_cache_view(self, fn) -> None:
         """Attach the read cache's per-class counter view (readcache
@@ -765,6 +820,7 @@ class QueryCoalescer:
             mesh_max=self._mesh_max,
             host_only=host_only,
             boundary_gen=bgen,
+            device_ok=self._device_ok(),
         )
 
     def _mesh_eligible(self, batch: List[_Item]) -> bool:
@@ -935,13 +991,28 @@ class QueryCoalescer:
                             keys, lo, hi, t0s, t1s, now, owners = (
                                 self._pack_args(batch)
                             )
-                            pq = submit(
-                                keys, lo, hi, t0s, t1s,
-                                now=now, owner_ids=owners,
-                                host_route=False,
-                            )
-                            kind = "table"
-                            used_device = self._pq_used_device(pq)
+                            try:
+                                # chaos seam: the cold fused dispatch
+                                chaos.fault_point("device.dispatch")
+                                pq = submit(
+                                    keys, lo, hi, t0s, t1s,
+                                    now=now, owner_ids=owners,
+                                    host_route=False,
+                                )
+                            except BaseException as e:
+                                if not self._absorb_device_loss(e):
+                                    raise
+                                # device lost at submit: demote THIS
+                                # batch to forced host chunks (the
+                                # collect stage runs them) — the
+                                # planner stops admitting the device
+                                # class from the next state capture
+                                host_route = True
+                                kind = "hostchunk"
+                                pq = None
+                            else:
+                                kind = "table"
+                                used_device = self._pq_used_device(pq)
             except BaseException as e:  # noqa: BLE001 — deliver to callers
                 self._deliver_error(batch, e)
                 with self._cond:
@@ -1026,7 +1097,14 @@ class QueryCoalescer:
                     # (plan already recorded at pack time)
                     self._execute(batch, record_plan=False)
             except BaseException as e:  # noqa: BLE001 — deliver to callers
-                self._deliver_error(batch, e)
+                if self._absorb_device_loss(e):
+                    # device lost while this batch was in flight:
+                    # re-serve it on the pure host path — callers pay
+                    # latency, never a 5xx (the ladder's DEVICE_LOST
+                    # contract)
+                    self._host_rerun(batch)
+                else:
+                    self._deliver_error(batch, e)
             collect_ms = (time.perf_counter() - t1) * 1000
             total_ms = pack_ms + device_ms + collect_ms
             with self._slock:
@@ -1107,7 +1185,13 @@ class QueryCoalescer:
         def done(results, err, gap_ms, lat_ms, used_device,
                  _batch=batch):
             if err is not None:
-                self._deliver_error(_batch, err)
+                if self._absorb_device_loss(err):
+                    # the stream died mid-flight: re-serve on the host
+                    # (runs on the loop's collector thread — the
+                    # stream is dead anyway, nothing to serialize on)
+                    self._host_rerun(_batch)
+                else:
+                    self._deliver_error(_batch, err)
             else:
                 self._deliver_results(_batch, results)
             with self._slock:
@@ -1242,12 +1326,26 @@ class QueryCoalescer:
                 # observable: inline traffic must feed the cost models
                 # too, or a low-load deployment would route on the
                 # boot seed forever
-                pq = submit(
-                    keys, lo, hi, t0s, t1s, now=now, owner_ids=owners,
-                    host_route=host_route,
-                )
-                used_device = self._pq_used_device(pq)
-                results = self._table.query_many_collect(pq)
+                try:
+                    if not host_route:
+                        chaos.fault_point("device.dispatch")
+                    pq = submit(
+                        keys, lo, hi, t0s, t1s, now=now,
+                        owner_ids=owners, host_route=host_route,
+                    )
+                    used_device = self._pq_used_device(pq)
+                    results = self._table.query_many_collect(pq)
+                except BaseException as e:
+                    if not self._absorb_device_loss(e):
+                        raise
+                    # device lost under a synchronous caller: retry
+                    # once on the pure host route
+                    pq = submit(
+                        keys, lo, hi, t0s, t1s, now=now,
+                        owner_ids=owners, host_route=True,
+                    )
+                    used_device = False
+                    results = self._table.query_many_collect(pq)
             else:
                 results = self._table.query_many(
                     keys, lo, hi, t0s, t1s, now=now, owner_ids=owners,
@@ -1292,6 +1390,8 @@ class QueryCoalescer:
                 co_route_hostchunk_batches=self._stat_route_hostchunk,
                 co_route_device_batches=self._stat_route_device,
                 co_route_resident_batches=self._stat_route_resident,
+                co_device_loss_absorbed=self._stat_device_loss_absorbed,
+                co_device_ok=int(self._device_ok()),
                 co_pack_ms_total=round(self._stat_pack_ms, 3),
                 co_device_ms_total=round(self._stat_device_ms, 3),
                 co_collect_ms_total=round(self._stat_collect_ms, 3),

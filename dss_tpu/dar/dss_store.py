@@ -33,7 +33,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from dss_tpu import errors
+from dss_tpu import chaos, errors
 from dss_tpu.clock import Clock, to_nanos
 from dss_tpu.dar import codec
 from dss_tpu.dar import readcache as rcache
@@ -208,9 +208,16 @@ class _CachedSearchMixin:
                     continue
                 pairs_ids.append(i)
                 t1s.append(t1)
-            cache.insert(
-                cls, key, fence, epoch, int(now_ns), pairs_ids, t1s
-            )
+            try:
+                # chaos seam: population is best-effort by contract —
+                # an injected failure here leaves the next poll a
+                # miss, never a wrong answer
+                chaos.fault_point("cache.populate", detail=cls)
+                cache.insert(
+                    cls, key, fence, epoch, int(now_ns), pairs_ids, t1s
+                )
+            except chaos.FaultError:
+                pass
         rcache.note_search(cls, epoch, fence[2], False)
         return ids
 
@@ -1070,6 +1077,14 @@ class DSSStore:
             )
         self.storage = storage
         self.clock = clock or Clock()
+        # the graceful-degradation ladder (chaos/ladder.py): ONE
+        # explicit health state machine for this store — the planner
+        # reads device_ok from it, the region client drives
+        # REGION_LOG_DOWN into it, and recovery re-warms (AOT grid)
+        # before re-admitting routes.  Surfaced in /status,
+        # X-DSS-Freshness, and the dss_degraded_mode gauge.
+        self.health = chaos.DegradationLadder()
+        self.health.on_recover("device_lost", self._rewarm_after_device_loss)
         self.wal = WriteAheadLog(None if region_url else wal_path, fsync=wal_fsync)
         self._lock = threading.RLock()
         self.region = None
@@ -1080,7 +1095,8 @@ class DSSStore:
             from dss_tpu.region.coordinator import RegionCoordinator
 
             self._region_client = RegionClient(
-                region_url, instance_id, auth_token=region_token
+                region_url, instance_id, auth_token=region_token,
+                health=self.health,
             )
             txn = self._region_txn
             # region epoch joins the cache fence: a promotion or a
@@ -1140,6 +1156,7 @@ class DSSStore:
                     lambda cls=cls: self.cache.class_stats(cls)
                 )
                 co.set_load_view(self.range_load)
+                co.set_health(self.health)
         self._replaying = False
         if region_url:
             self.region = RegionCoordinator(
@@ -1157,6 +1174,21 @@ class DSSStore:
 
     def _region_txn(self):
         return self.region.txn()
+
+    def _rewarm_after_device_loss(self) -> None:
+        """Recovery hook (ladder.on_recover): a returning device must
+        be warm BEFORE the planner re-admits the device class, or the
+        first post-recovery batches pay compile storms inside their
+        deadlines.  Best-effort — a failed warm only means lazy
+        warm-on-traffic, exactly the cold-boot behavior."""
+        try:
+            self.warm_resident()
+        except Exception:  # noqa: BLE001 — recovery must not wedge
+            import logging
+
+            logging.getLogger("dss.chaos").exception(
+                "post-device-loss re-warm failed; warming lazily"
+            )
 
     def _journal(self, rec: dict):
         if self._replaying:
@@ -1303,6 +1335,20 @@ class DSSStore:
         # measurement input)
         for k, v in self.range_load.stats().items():
             out[f"dss_{k}"] = v
+        # degradation ladder + fault-injection + breaker gauges: the
+        # key set is stable on every deployment (dict-valued entries
+        # render as labeled families — dss_breaker_state{remote},
+        # dss_fault_injected_total{site})
+        out.update(self.health.stats())
+        out["dss_fault_injected_total"] = (
+            chaos.registry().injected_by_site()
+        )
+        breakers = {}
+        if self.region is not None:
+            fn = getattr(self._region_client, "breaker_states", None)
+            if fn is not None:
+                breakers = fn()
+        out["dss_breaker_state"] = breakers
         if self.region is not None:
             out.update(self.region.stats())
         return out
@@ -1335,4 +1381,8 @@ class DSSStore:
             "epoch": epoch,
             "cache": self.cache.stats(),
             "classes": classes,
+            # the degradation ladder's operator view: current mode +
+            # every active condition with its age and reason
+            "degraded_mode": self.health.mode_name(),
+            "degraded": self.health.active(),
         }

@@ -15,6 +15,8 @@ import os
 import threading
 from typing import Callable, Iterator, Optional
 
+from dss_tpu.chaos import fault_point
+
 # Log format version.  A head record {"t": "__format__", "version": N}
 # gates boot: replaying a log written by an incompatible future format
 # must refuse loudly instead of rebuilding garbage state — the
@@ -183,12 +185,17 @@ class WriteAheadLog:
 
     def append(self, record: dict) -> int:
         with self._lock:
+            # chaos seam BEFORE the seq assignment/write: an injected
+            # append error leaves no half-recorded state, and a delay
+            # models a slow disk stalling the writer
+            fault_point("wal.append")
             self._seq += 1
             record = dict(record, seq=self._seq)
             if self._fh is not None:
                 self._fh.write(json.dumps(record, separators=(",", ":")) + "\n")
                 self._fh.flush()
                 if self.fsync:
+                    fault_point("wal.fsync")
                     os.fsync(self._fh.fileno())
             return self._seq
 
@@ -199,6 +206,7 @@ class WriteAheadLog:
         with self._lock:
             if self._fh is not None:
                 self._fh.flush()
+                fault_point("wal.fsync")
                 os.fsync(self._fh.fileno())
 
     def replay(self) -> Iterator[dict]:

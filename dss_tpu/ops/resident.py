@@ -71,6 +71,7 @@ from typing import Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from dss_tpu.chaos import fault_point
 from dss_tpu.ops import conflict as _conflict  # noqa: F401 — enables
 #   x64 before the first jax array touch (the kernel's i64 columns)
 from dss_tpu.ops import fastpath
@@ -214,6 +215,10 @@ class AotCache:
             sds((int(batch_bucket),), jnp.int64),  # q_t1
         )
         t0 = time.perf_counter()
+        # chaos seam: an injected failure models an XLA compile error
+        # for one bucket — the async compiler logs and drops it, and
+        # submits in that bucket keep riding the shared jit
+        fault_point("aot.compile", detail=str(key))
         exe = (
             self._donating_jit()
             .lower(*args, max_words=int(max_words))
@@ -472,6 +477,10 @@ class ResidentLoop:
                 self._cond.notify_all()
             t_sub = time.perf_counter()
             try:
+                # chaos seam: device loss mid-stream — the error rides
+                # the normal delivery path to the coalescer's done
+                # callback, which absorbs it (host re-run + ladder)
+                fault_point("resident.submit")
                 keys, lo, hi, t0s, t1s, now, owners = payload
                 pq = self._table.query_many_submit(
                     keys, lo, hi, t0s, t1s, now=now, owner_ids=owners,

@@ -173,6 +173,11 @@ class MultihostRuntime:
     # -- coordination primitives ---------------------------------------------
 
     def kv_set(self, key: str, value: bytes) -> None:
+        # chaos seam: the leader's refresh/command broadcast rides
+        # this KV — an injected failure here is a DCN refresh loss
+        from dss_tpu.chaos import fault_point
+
+        fault_point("multihost.refresh", detail=key)
         self._client.key_value_set_bytes(f"dssmh/{key}", value)
 
     def kv_get(self, key: str, timeout_s: float) -> bytes:
@@ -189,6 +194,12 @@ class MultihostRuntime:
             pass
 
     def barrier(self, name: str, timeout_s: float) -> None:
+        # chaos seam: an injected barrier failure is a peer loss (the
+        # watchdog's exception path -> mark_degraded, exactly as a
+        # real missing process); a delay is a slow DCN hop
+        from dss_tpu.chaos import fault_point
+
+        fault_point("multihost.barrier", detail=name)
         self._client.wait_at_barrier(
             f"dssmh-{name}", int(timeout_s * 1000)
         )

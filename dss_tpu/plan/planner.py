@@ -97,6 +97,12 @@ class ModelState:
     mesh_max: int = 256
     host_only: bool = False  # event-loop caller: no forced chunk scans
     boundary_gen: int = 0  # shard placement generation (PR 8)
+    # degradation ladder (dss_tpu/chaos/ladder.py): DEVICE_LOST makes
+    # every device-class route (device / resident / mesh) inadmissible
+    # while hostchunk + inline keep serving — the same
+    # route-substitution move the host_only path already makes, now
+    # driven by store health instead of caller context
+    device_ok: bool = True
 
     # -- predictions (the shared formulas from plan.costs) ------------
 
@@ -190,6 +196,7 @@ def mesh_admissible(shape: BatchShape, state: ModelState) -> bool:
     beats serialized mesh chunk round trips."""
     return (
         state.mesh_ready
+        and state.device_ok  # the mesh IS local device compute
         and shape.all_stale
         and not shape.owner_scoped
         and state.mesh_min <= shape.n <= state.mesh_max
@@ -227,8 +234,12 @@ def enumerate_candidates(
         )
     if not (shape.inline and state.host_only):
         cand["hostchunk"] = state.predict_host_ms(n)
-    cand["device"] = state.predict_device_ms(n)
-    if allow_resident and state.resident_ready and not shape.inline:
+    if state.device_ok:
+        cand["device"] = state.predict_device_ms(n)
+    if (
+        allow_resident and state.resident_ready and state.device_ok
+        and not shape.inline
+    ):
         cand["resident"] = (
             state.predict_resident_ms(n)
             if headroom_ms is None
@@ -286,6 +297,18 @@ def decide(
         return mk("mesh", cand["mesh"], fresh="bounded_stale")
     pred_dev = cand["device"]
     res = cand["resident"]
+    if pred_dev is None:
+        # DEVICE_LOST (degradation ladder): the whole device class is
+        # inadmissible — serve from the host, exactly as the deadline
+        # router already does under pressure.  Lone callers keep the
+        # inline exact path; everything else rides hostchunk.
+        hc = cand["hostchunk"]
+        if shape.inline and (hc is None or n < state.chunk):
+            return mk("inline", cand["inline"])
+        return mk(
+            "hostchunk",
+            hc if hc is not None else state.predict_host_ms(n),
+        )
     if headroom_ms is None:
         if res is not None and res < pred_dev:
             return mk("resident", res)
@@ -328,12 +351,19 @@ def plan_drain_cap(
     if headroom_ms is None:
         return cur
     budget_ms = HEADROOM_SAFETY * max(0.0, headroom_ms)
-    pred_dev = state.predict_device_ms(cur)
-    if state.resident_ready:
-        # latency view, matching the route choice: a drain sized
-        # against the stream's throughput gap would admit batches the
-        # stream cannot deliver inside their deadlines
-        pred_dev = min(pred_dev, state.predict_resident_latency_ms(cur))
+    if not state.device_ok:
+        # DEVICE_LOST: the device class can never absorb the drain —
+        # size against the host chunks below, unconditionally
+        pred_dev = float("inf")
+    else:
+        pred_dev = state.predict_device_ms(cur)
+        if state.resident_ready:
+            # latency view, matching the route choice: a drain sized
+            # against the stream's throughput gap would admit batches
+            # the stream cannot deliver inside their deadlines
+            pred_dev = min(
+                pred_dev, state.predict_resident_latency_ms(cur)
+            )
     if pred_dev <= budget_ms:
         return cur
     if state.predict_host_ms(cur) >= pred_dev:
@@ -396,6 +426,7 @@ class Planner:
         mesh_max: int = 256,
         host_only: bool = False,
         boundary_gen: int = 0,
+        device_ok: bool = True,
     ) -> ModelState:
         return state_of(
             self.cost,
@@ -408,6 +439,7 @@ class Planner:
             mesh_max=mesh_max,
             host_only=host_only,
             boundary_gen=boundary_gen,
+            device_ok=device_ok,
         )
 
     # -- planning ---------------------------------------------------------

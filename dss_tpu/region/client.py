@@ -10,17 +10,28 @@ failover in progress surfaces as a short stall instead of an
 immediate error.  Appends carry a per-call txn id the server dedups
 on, which is what makes retrying them safe (a retry of an append that
 actually landed returns the original index instead of double-
-appending)."""
+appending).
+
+Backoff rides the stack-wide shared policy (dss_tpu/chaos/retry.py)
+and every endpoint carries a circuit breaker: consecutive transport
+failures open it (dss_breaker_state{remote} in /metrics), rotation
+prefers endpoints whose breaker allows traffic, and all-breakers-open
+flips the store's degradation ladder to REGION_LOG_DOWN — writes then
+shed 503 with the breaker cooldown as an honest Retry-After while
+reads keep serving.  The breaker never hard-blocks the only available
+endpoint: on a single-URL client an open breaker just means every
+attempt is a half-open probe."""
 
 from __future__ import annotations
 
 import json
-import random
 import time
 import uuid
 from typing import List, Optional, Tuple
 
 import requests
+
+from dss_tpu import chaos
 
 
 class RegionError(RuntimeError):
@@ -61,6 +72,7 @@ class RegionClient:
         http_timeout_s: float = 5.0,
         retry_deadline_s: float = 3.0,
         max_retries: int = 4,
+        health=None,  # chaos.DegradationLadder: region_log_down signal
     ):
         if isinstance(base_url, (list, tuple)):
             urls = [str(u) for u in base_url]
@@ -82,6 +94,17 @@ class RegionClient:
         # failover/retry observability (coordinator.stats -> /metrics)
         self.failovers = 0
         self.transport_retries = 0
+        # the shared stack-wide backoff policy (same curve the old
+        # hand-rolled min(0.05 * 2**a, 0.5) * (0.5+rand) loop drew)
+        self._retry_policy = chaos.RetryPolicy(
+            base_s=0.05, cap_s=0.5, multiplier=2.0, jitter=0.5
+        )
+        # per-endpoint circuit breakers: rotation prefers allowed
+        # endpoints; all-open drives the degradation ladder
+        self._breakers = chaos.BreakerRegistry(
+            fail_threshold=3, reset_s=2.0
+        )
+        self._health = health
         # last ADOPTED server epoch vs last SEEN on the wire:
         # a mismatch raises EpochChanged until a resync site adopts
         self._epoch: Optional[str] = None
@@ -100,7 +123,10 @@ class RegionClient:
 
     def _next_endpoint(self, hint: Optional[str], tried: set) -> None:
         """Move to the server-hinted primary when it is fresh, else the
-        next endpoint not yet tried during this call.  Hints outside
+        next endpoint not yet tried during this call — preferring
+        endpoints whose circuit breaker allows traffic (an open
+        breaker only deprioritizes: if every untried endpoint is open,
+        the first one still gets the probe).  Hints outside
         the CONFIGURED list are ignored: a mirror left on its default
         loopback --advertise_url would otherwise permanently poison
         the rotation with a URL that is local to the wrong host."""
@@ -110,12 +136,19 @@ class RegionClient:
                 self._active = self._urls.index(hint)
                 return
         n = len(self._urls)
+        fallback = None
         for k in range(1, n + 1):
             cand = (self._active + k) % n
-            if self._urls[cand] not in tried:
+            if self._urls[cand] in tried:
+                continue
+            if self._breakers.get(self._urls[cand]).allow():
                 self._active = cand
                 return
-        self._active = (self._active + 1) % n
+            if fallback is None:
+                fallback = cand
+        self._active = (
+            fallback if fallback is not None else (self._active + 1) % n
+        )
 
     def _request(self, method: str, path: str, *, timeout=None, **kw):
         """One HTTP call; retries transport failures (connection
@@ -134,15 +167,21 @@ class RegionClient:
             url = self._urls[self._active]
             hint = None
             try:
+                # chaos seam: an injected error/partition here reads
+                # exactly like a connection failure (retried, failed
+                # over, breaker-counted); a delay models a slow link
+                chaos.fault_point("region.client.request", detail=url)
                 r = self._session.request(
                     method, url + path, timeout=timeout or self._timeout,
                     **kw,
                 )
-            except requests.RequestException as e:
+            except (requests.RequestException, chaos.FaultError) as e:
                 last = f"{url}: {e}"
                 r = None
             if r is not None:
                 if r.status_code < 500:
+                    self._breakers.get(url).record_success()
+                    self._note_region_ok()
                     return r
                 body = self._json(r)
                 hint = body.get("primary")
@@ -150,6 +189,7 @@ class RegionClient:
                     f"{url}: {r.status_code} "
                     f"{body.get('error', '')}".strip()
                 )
+            self._breakers.get(url).record_failure()
             if attempt >= attempts:
                 break
             tried.add(url)
@@ -168,10 +208,37 @@ class RegionClient:
             self.transport_retries += 1
             if self._active != before:
                 self.failovers += 1
-            time.sleep(
-                min(0.05 * (2 ** attempt), 0.5) * (0.5 + random.random())
-            )
+            time.sleep(self._retry_policy.backoff_s(attempt))
+        self._note_region_down(last)
         raise RegionError(f"region log {method} {path} failed: {last}")
+
+    def _note_region_down(self, reason: str) -> None:
+        """The whole retry budget burned without an answer: flip the
+        degradation ladder once every endpoint's breaker is open (a
+        single slow call must not page the region as down)."""
+        if self._health is not None and self._breakers.all_open():
+            self._health.enter(
+                "region_log_down", f"region log unreachable: {reason}"
+            )
+
+    def _note_region_ok(self) -> None:
+        if self._health is not None:
+            self._health.exit("region_log_down")
+
+    def set_health(self, ladder) -> None:
+        """Attach the store's degradation ladder (dss_store wiring)."""
+        self._health = ladder
+
+    def breaker_states(self) -> dict:
+        """endpoint -> 0 closed / 1 half-open / 2 open — the
+        dss_breaker_state{remote} gauge family."""
+        return self._breakers.states()
+
+    def retry_after_s(self) -> float:
+        """Honest Retry-After for writes shed during a region outage:
+        the soonest any endpoint's breaker allows a probe (floor 0.5 s
+        so clients cannot busy-poll a flapping link)."""
+        return max(0.5, self._breakers.min_cooldown_s(default=1.0))
 
     def _check_epoch(self, body: dict) -> None:
         """Raise EpochChanged when the server's epoch moved off the

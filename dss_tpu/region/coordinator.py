@@ -57,7 +57,7 @@ import threading
 import time
 from typing import List, Optional, Tuple
 
-from dss_tpu import errors
+from dss_tpu import chaos, errors
 from dss_tpu.region.client import (
     EpochChanged,
     OptimisticRejected,
@@ -100,7 +100,22 @@ class RegionCoordinator:
         self._resyncs = 0
         self._rollbacks = 0
         self._optimistic = optimistic
-        self._conflict_backoff_s = conflict_backoff_s
+        # conflict cool-down rides the shared jittered policy
+        # (dss_tpu/chaos/retry.py): `conflict_backoff_s` is now the
+        # CAP, the base is a quarter of it, and consecutive conflicts
+        # grow the window — so two coordinators that collide once
+        # cannot re-collide in lockstep the way the old fixed 2.0 s
+        # sleep guaranteed.  A successful optimistic commit resets the
+        # growth (the deadline-awareness: cool-downs never outlive the
+        # conflict streak that earned them).
+        self._conflict_policy = chaos.RetryPolicy(
+            base_s=max(1e-3, conflict_backoff_s / 4.0),
+            cap_s=max(1e-3, conflict_backoff_s),
+            multiplier=2.0,
+            jitter=0.5,
+        )
+        self._conflict_streak = 0
+        self._last_conflict_backoff_s = 0.0
         self._lease_only_until = 0.0
         self._opt_commits = 0
         self._opt_conflicts = 0
@@ -180,6 +195,13 @@ class RegionCoordinator:
             "region_rollbacks": self._rollbacks,
             "region_optimistic_commits": self._opt_commits,
             "region_optimistic_conflicts": self._opt_conflicts,
+            # the last conflict cool-down drawn from the shared policy
+            # (the coordinator's analog of region_mirror_backoff_s):
+            # nonzero means this instance recently lost a disjointness
+            # race and is routing writes through the lease
+            "region_conflict_backoff_s": round(
+                self._last_conflict_backoff_s, 3
+            ),
             # transport-level failover/retry counters (client-side view
             # of mirror failovers and region hiccups)
             "region_failovers": getattr(self._client, "failovers", 0),
@@ -258,7 +280,7 @@ class RegionCoordinator:
                 try:
                     self._resync_locked()
                 except RegionError as e:
-                    raise errors.unavailable(f"region resync: {e}")
+                    raise self._unavailable(f"region resync: {e}")
 
             if (
                 self._optimistic
@@ -313,11 +335,11 @@ class RegionCoordinator:
                         self._resync_locked()
                         token, head = self._client.acquire_lease()
                     except RegionError as e:  # incl. a second epoch flip
-                        raise errors.unavailable(
+                        raise self._unavailable(
                             f"region write lease: {e}"
                         )
                 except RegionError as e:
-                    raise errors.unavailable(f"region write lease: {e}")
+                    raise self._unavailable(f"region write lease: {e}")
                 finally:
                     self._phase_ms["lease"] += (
                         time.perf_counter() - t_ph
@@ -336,7 +358,7 @@ class RegionCoordinator:
                         # nothing lands meanwhile).
                         self._catch_up_locked()
                 except RegionError as e:
-                    raise errors.unavailable(f"region catch-up: {e}")
+                    raise self._unavailable(f"region catch-up: {e}")
                 finally:
                     self._phase_ms["catchup"] += (
                         time.perf_counter() - t_ph
@@ -370,6 +392,24 @@ class RegionCoordinator:
                             time.perf_counter() - t_ph
                         ) * 1000
 
+    def _unavailable(self, msg: str):
+        """503 for a region-path failure, carrying an HONEST
+        Retry-After (the client's breaker cooldown) instead of letting
+        clients guess — the degradation ladder's REGION_LOG_DOWN
+        contract: writes shed with a horizon, reads keep serving."""
+        e = errors.unavailable(msg)
+        ra = getattr(self._client, "retry_after_s", None)
+        e.retry_after_s = ra() if ra is not None else 1.0
+        return e
+
+    def _conflict_cooldown_s(self) -> float:
+        """Next lease-only cool-down: jittered, exponential in the
+        consecutive-conflict streak, capped at conflict_backoff_s."""
+        d = self._conflict_policy.backoff_s(self._conflict_streak)
+        self._conflict_streak += 1
+        self._last_conflict_backoff_s = d
+        return d
+
     def _commit_optimistic_locked(self, buf: List[dict]) -> None:
         wire = [
             {k: v for k, v in rec.items() if k != "undo"} for rec in buf
@@ -379,7 +419,9 @@ class RegionCoordinator:
             # can't prove disjointness: roll back and route the retry
             # through the lease for a while
             self._rollback_locked(buf)
-            self._lease_only_until = time.monotonic() + self._conflict_backoff_s
+            self._lease_only_until = (
+                time.monotonic() + self._conflict_cooldown_s()
+            )
             e = errors.unavailable(
                 "region txn footprint unknown; retry (lease path)"
             )
@@ -394,7 +436,9 @@ class RegionCoordinator:
             # only run once), surface a retryable 503
             self._rollback_locked(buf)
             self._opt_conflicts += 1
-            self._lease_only_until = time.monotonic() + self._conflict_backoff_s
+            self._lease_only_until = (
+                time.monotonic() + self._conflict_cooldown_s()
+            )
             err = errors.unavailable(
                 f"region write conflict ({e}); rolled back, retry"
             )
@@ -404,7 +448,7 @@ class RegionCoordinator:
             # ambiguous network failure: same convergence story as the
             # lease path (rollback; tail re-applies if it landed)
             self._rollback_locked(buf)
-            raise errors.unavailable(
+            raise self._unavailable(
                 f"region append failed; local txn rolled back "
                 f"(re-applied from the log if it landed): {e}"
             )
@@ -413,6 +457,7 @@ class RegionCoordinator:
                 time.perf_counter() - t_ph
             ) * 1000
         self._opt_commits += 1
+        self._conflict_streak = 0  # a landed append ends the streak
         if idx == self._applied:
             self._applied += 1
             return
@@ -438,7 +483,7 @@ class RegionCoordinator:
             # converge via the poller instead: undo ours; the tail
             # applies everything (theirs + ours) in log order
             self._rollback_locked(buf)
-            raise errors.unavailable(
+            raise self._unavailable(
                 f"region interleave fetch failed; rolled back, "
                 f"converging via the log: {e}"
             )
@@ -491,7 +536,7 @@ class RegionCoordinator:
             # if the append did land the tail poller re-applies it from
             # the log.  Converges without a resync in both cases.
             self._rollback_locked(buf)
-            raise errors.unavailable(
+            raise self._unavailable(
                 f"region append failed; local txn rolled back "
                 f"(re-applied from the log if it landed): {e}"
             )
@@ -509,7 +554,7 @@ class RegionCoordinator:
                 # after the rollback: local consistency must never
                 # hinge on a lease-release round trip succeeding
                 self._client.release_lease(token)
-            raise errors.unavailable(
+            raise self._unavailable(
                 f"region log order broke (appended at {idx}, expected "
                 f"{self._applied}); rolled back, converging via the log"
             )

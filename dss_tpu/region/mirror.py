@@ -53,6 +53,7 @@ from typing import Dict, Optional
 
 from aiohttp import web
 
+from dss_tpu import chaos
 from dss_tpu.obs.metrics import MetricsRegistry
 
 log_ = logging.getLogger("dss.region.mirror")
@@ -60,6 +61,16 @@ log_ = logging.getLogger("dss.region.mirror")
 REPL_BATCH = 64  # entries per /replicate push
 HEARTBEAT_S = 1.0  # mirror -> primary register cadence
 PRUNE_AFTER_S = 30.0  # drop mirrors silent for this long
+
+# shared stack-wide backoff for the per-mirror sender loop (replaces
+# the hand-rolled min(0.1 * 2**fails, 2.0) * (0.5+rand)): capped and
+# jittered via ONE policy so a flapping mirror backs off exactly like
+# every other transport in the stack, and its CURRENT backoff is
+# exported (region_mirror_backoff_s) so the flap is visible before the
+# lag alert fires
+_SENDER_BACKOFF = chaos.RetryPolicy(
+    base_s=0.1, cap_s=2.0, multiplier=2.0, jitter=0.5
+)
 
 # every metric name the region log server exports at /metrics —
 # imported by tests/test_deploy_observability.py so dashboards and
@@ -78,6 +89,7 @@ REGION_SERVER_METRICS = (
     "region_quorum_failures_total",
     "region_stale_primary_rejects_total",
     "region_replicated_entries_total",
+    "region_mirror_backoff_s",
 )
 
 
@@ -94,6 +106,7 @@ class _MirrorPeer:
         self.last_seen = time.monotonic()
         self.last_error: Optional[str] = None
         self.fails = 0  # consecutive push failures (backoff)
+        self.backoff_s = 0.0  # current sender backoff (0 = healthy)
 
 
 class RegionNode:
@@ -198,6 +211,13 @@ class RegionNode:
     async def _post(self, url: str, payload: dict):
         import aiohttp
 
+        # chaos seam: a dropped/delayed push reads exactly like a
+        # flaky replication link (the sender loop backs off and
+        # retries; quorum math and promotion fencing are unaffected —
+        # tests/test_region_mirror.py pins this under injected flaps)
+        await chaos.async_fault_point(
+            "region.mirror.replicate", detail=url
+        )
         t = aiohttp.ClientTimeout(total=self.repl_timeout_s)
         async with self._session.post(url, json=payload, timeout=t) as r:
             try:
@@ -245,6 +265,7 @@ class RegionNode:
                         time.monotonic() - m.last_seen, 1
                     ),
                     "last_error": m.last_error,
+                    "backoff_s": round(m.backoff_s, 3),
                 }
                 for m in self.mirrors.values()
             },
@@ -277,6 +298,16 @@ class RegionNode:
         )
         r.set_counter(
             "region_replicated_entries_total", self.replicated_entries
+        )
+        # the worst current sender backoff: nonzero means a mirror
+        # link is flapping RIGHT NOW, before lag accumulates enough to
+        # trip the lag alert
+        r.set_gauge(
+            "region_mirror_backoff_s",
+            max(
+                (m.backoff_s for m in self.mirrors.values()),
+                default=0.0,
+            ),
         )
         return r.render()
 
@@ -393,15 +424,14 @@ class RegionNode:
             try:
                 await self._drain(m)
                 m.fails = 0
+                m.backoff_s = 0.0
             except asyncio.CancelledError:
                 raise
             except Exception as e:  # noqa: BLE001 — keep the stream alive
                 m.fails += 1
                 m.last_error = repr(e)
-                await asyncio.sleep(
-                    min(0.1 * (2 ** min(m.fails, 5)), 2.0)
-                    * (0.5 + random.random())
-                )
+                m.backoff_s = _SENDER_BACKOFF.backoff_s(m.fails - 1)
+                await asyncio.sleep(m.backoff_s)
                 if time.monotonic() - m.last_seen < PRUNE_AFTER_S:
                     m.wake.set()  # retry until the registry prunes it
 

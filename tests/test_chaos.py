@@ -1,0 +1,807 @@
+"""Deterministic fault injection, the shared retry policy, and the
+graceful-degradation ladder (ISSUE 11 tentpole).
+
+Unit tier: FaultPlan scheduling is replayable byte-for-byte, the three
+legacy retry loops (RegionClient transport, mirror sender, coordinator
+conflict cool-down) ride ONE jittered policy with pinned bounds,
+circuit breakers walk closed/open/half-open, and the ladder makes the
+planner's device-class routes inadmissible under DEVICE_LOST while the
+coalescer absorbs in-flight device losses (host re-run, no caller
+error).  The store-level differential (faulted run == no-fault oracle)
+lives in test_store_fuzz; the replicate-link-flap promotion fencing in
+test_region_mirror; the end-to-end scenarios in bench.py --leg chaos.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dss_tpu import chaos, errors
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    """The fault registry is process-global: every test starts and
+    ends with no plan installed and fresh counters."""
+    chaos.clear_plan()
+    chaos.registry().reset_counters()
+    yield
+    chaos.clear_plan()
+    chaos.registry().reset_counters()
+
+
+# -- fault plans -------------------------------------------------------------
+
+
+def test_fault_point_is_noop_without_plan():
+    chaos.fault_point("wal.fsync")
+    chaos.fault_point("device.dispatch")
+    # the zero-overhead gate: no plan -> not even a hit is counted
+    assert chaos.registry().hits_by_site() == {}
+
+
+def test_event_after_count_window():
+    chaos.install_plan(
+        {"events": [{"site": "s", "action": "error", "after": 2,
+                     "count": 2}]}
+    )
+    fired = []
+    for i in range(6):
+        try:
+            chaos.fault_point("s")
+            fired.append(False)
+        except chaos.FaultError:
+            fired.append(True)
+    # hits 1-2 skipped, 3-4 inject, 5-6 exhausted
+    assert fired == [False, False, True, True, False, False]
+    assert chaos.registry().injected_by_site() == {"s": 2}
+    assert chaos.registry().hits_by_site() == {"s": 6}
+
+
+def test_match_filters_on_detail():
+    chaos.install_plan(
+        {"events": [{"site": "s", "match": "/replicate", "count": -1}]}
+    )
+    chaos.fault_point("s", detail="http://a/mirror/register")  # no match
+    with pytest.raises(chaos.FaultError):
+        chaos.fault_point("s", detail="http://a/replicate")
+
+
+def test_actions_raise_typed_errors():
+    chaos.install_plan(
+        {"events": [
+            {"site": "a", "action": "device_lost", "count": -1},
+            {"site": "b", "action": "partition", "count": -1},
+        ]}
+    )
+    with pytest.raises(chaos.DeviceLostError):
+        chaos.fault_point("a")
+    with pytest.raises(chaos.FaultError) as ei:
+        chaos.fault_point("b")
+    assert ei.value.kind == "partition"
+    assert chaos.is_device_loss(chaos.DeviceLostError("a"))
+    assert not chaos.is_device_loss(RuntimeError("x"))
+
+
+def test_delay_action_sleeps():
+    chaos.install_plan(
+        {"events": [{"site": "s", "action": "delay",
+                     "delay_s": 0.05, "count": 1}]}
+    )
+    t0 = time.perf_counter()
+    chaos.fault_point("s")
+    assert time.perf_counter() - t0 >= 0.045
+    t0 = time.perf_counter()
+    chaos.fault_point("s")  # exhausted: no delay
+    assert time.perf_counter() - t0 < 0.02
+
+
+def test_async_fault_point_delay_and_error():
+    chaos.install_plan(
+        {"events": [
+            {"site": "s", "action": "delay", "delay_s": 0.03, "count": 1},
+            {"site": "s", "action": "error", "count": 1},
+        ]}
+    )
+
+    async def run():
+        t0 = time.perf_counter()
+        await chaos.async_fault_point("s")
+        assert time.perf_counter() - t0 >= 0.025
+        with pytest.raises(chaos.FaultError):
+            await chaos.async_fault_point("s")
+
+    asyncio.run(run())
+
+
+def test_probabilistic_events_replay_byte_identical():
+    """Same seed + same hit sequence -> the SAME injections, run after
+    run — the replayability contract the fuzz oracle depends on."""
+
+    def run_once():
+        plan = chaos.FaultPlan.from_dict(
+            {"seed": 42, "events": [
+                {"site": "s", "p": 0.5, "count": -1},
+            ]}
+        )
+        chaos.install_plan(plan)
+        out = []
+        for _ in range(64):
+            try:
+                chaos.fault_point("s")
+                out.append(0)
+            except chaos.FaultError:
+                out.append(1)
+        chaos.clear_plan()
+        return out
+
+    a, b = run_once(), run_once()
+    assert a == b
+    assert 0 < sum(a) < 64  # p=0.5 actually thins
+
+    # a different seed draws a different schedule
+    plan = chaos.FaultPlan.from_dict(
+        {"seed": 43, "events": [{"site": "s", "p": 0.5, "count": -1}]}
+    )
+    chaos.install_plan(plan)
+    c = []
+    for _ in range(64):
+        try:
+            chaos.fault_point("s")
+            c.append(0)
+        except chaos.FaultError:
+            c.append(1)
+    assert c != a
+
+
+def test_env_plan_inline_json(monkeypatch):
+    monkeypatch.setenv(
+        "DSS_FAULT_PLAN",
+        '{"seed": 1, "events": [{"site": "s", "count": 1}]}',
+    )
+    assert chaos.load_env_plan()
+    with pytest.raises(chaos.FaultError):
+        chaos.fault_point("s")
+
+
+def test_env_plan_file(tmp_path, monkeypatch):
+    p = tmp_path / "plan.json"
+    p.write_text('{"events": [{"site": "s", "count": 1}]}')
+    monkeypatch.setenv("DSS_FAULT_PLAN", str(p))
+    assert chaos.load_env_plan()
+    with pytest.raises(chaos.FaultError):
+        chaos.fault_point("s")
+
+
+def test_unknown_action_rejected():
+    with pytest.raises(ValueError):
+        chaos.FaultEvent("s", "explode")
+
+
+# -- retry policy ------------------------------------------------------------
+
+
+def test_retry_policy_bounds_and_cap():
+    pol = chaos.RetryPolicy(
+        base_s=0.1, cap_s=2.0, multiplier=2.0, jitter=0.5
+    )
+    for attempt, raw in ((0, 0.1), (1, 0.2), (2, 0.4), (10, 2.0)):
+        assert pol.raw_backoff_s(attempt) == pytest.approx(raw)
+        for _ in range(32):
+            d = pol.backoff_s(attempt)
+            assert raw * 0.5 <= d <= raw * 1.5
+
+
+def test_retry_policy_survives_unbounded_attempt_counters():
+    """Callers feed raw failure streaks (a mirror flapping for an
+    hour): the exponent must clamp before exponentiating, or the
+    backoff call itself raises OverflowError inside the retry loop."""
+    pol = chaos.RetryPolicy(base_s=0.1, cap_s=2.0)
+    for attempt in (1_000, 10_000, 10**9):
+        assert pol.raw_backoff_s(attempt) == 2.0
+        assert 1.0 <= pol.backoff_s(attempt) <= 3.0
+
+
+def test_retry_policy_seeded_determinism():
+    a = chaos.RetryPolicy(base_s=0.1, cap_s=1.0, seed=7)
+    b = chaos.RetryPolicy(base_s=0.1, cap_s=1.0, seed=7)
+    assert [a.backoff_s(i) for i in range(8)] == [
+        b.backoff_s(i) for i in range(8)
+    ]
+
+
+def test_retry_policy_sleep_respects_deadline():
+    pol = chaos.RetryPolicy(base_s=10.0, cap_s=10.0, jitter=0.0)
+    slept = []
+    d = chaos.Deadline(0.02)
+    assert pol.sleep(0, d, sleep_fn=slept.append) <= 0.02
+    assert len(slept) == 1 and slept[0] <= 0.02
+    time.sleep(0.025)
+    assert d.expired()
+    assert pol.sleep(0, d, sleep_fn=slept.append) == 0.0
+    assert len(slept) == 1  # expired budget -> no sleep at all
+
+
+# -- circuit breaker ---------------------------------------------------------
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_breaker_transitions():
+    clk = FakeClock()
+    b = chaos.CircuitBreaker(fail_threshold=3, reset_s=5.0, clock=clk)
+    assert b.state == chaos.BREAKER_CLOSED and b.allow()
+    b.record_failure()
+    b.record_failure()
+    assert b.state == chaos.BREAKER_CLOSED  # below threshold
+    b.record_failure()
+    assert b.state == chaos.BREAKER_OPEN
+    assert not b.allow()
+    assert b.cooldown_remaining_s() == pytest.approx(5.0)
+    clk.t += 5.1
+    # cooldown elapsed: half-open, probes allowed
+    assert b.state == chaos.BREAKER_HALF_OPEN
+    assert b.allow()
+    # failed probe re-opens immediately (no threshold re-accumulation)
+    b.record_failure()
+    assert b.state == chaos.BREAKER_OPEN
+    clk.t += 5.1
+    assert b.allow()
+    b.record_success()
+    assert b.state == chaos.BREAKER_CLOSED
+    assert b.trips == 2
+
+
+def test_breaker_registry_all_open_and_cooldown():
+    clk = FakeClock()
+    reg = chaos.BreakerRegistry(fail_threshold=1, reset_s=4.0, clock=clk)
+    assert not reg.all_open()  # no remotes yet
+    reg.get("a").record_failure()
+    assert reg.all_open()
+    reg.get("b")  # second remote, closed
+    assert not reg.all_open()
+    reg.get("b").record_failure()
+    assert reg.all_open()
+    assert reg.states() == {
+        "a": chaos.BREAKER_OPEN, "b": chaos.BREAKER_OPEN,
+    }
+    assert reg.min_cooldown_s() == pytest.approx(4.0)
+
+
+# -- degradation ladder ------------------------------------------------------
+
+
+def test_ladder_severity_and_recovery_order():
+    clk = FakeClock()
+    lad = chaos.DegradationLadder(clock=clk)
+    assert lad.mode() == chaos.HEALTHY
+    assert lad.device_ok() and lad.region_ok()
+
+    order = []
+    lad.on_recover("device_lost", lambda: order.append("rewarm"))
+
+    assert lad.enter("device_lost", "injected")
+    assert not lad.enter("device_lost", "again")  # idempotent
+    assert lad.mode() == chaos.DEVICE_LOST
+    assert not lad.device_ok()
+
+    lad.enter("region_log_down", "breakers open")
+    assert lad.mode() == chaos.REGION_LOG_DOWN  # worst active wins
+    lad.enter("mesh_degraded", "peer lost")
+    assert lad.mode() == chaos.REGION_LOG_DOWN
+
+    lad.exit("region_log_down")
+    assert lad.mode() == chaos.MESH_DEGRADED
+    lad.exit("mesh_degraded")
+    assert lad.mode() == chaos.DEVICE_LOST
+
+    # re-warm runs BEFORE the condition clears (re-admission gating)
+    lad.on_recover(
+        "device_lost",
+        lambda: order.append(
+            "still-lost" if not lad.device_ok() else "cleared-early"
+        ),
+    )
+    clk.t += 3.0
+    assert lad.exit("device_lost")
+    assert order == ["rewarm", "still-lost"]
+    assert not lad.exit("device_lost")  # already clear
+    assert lad.mode() == chaos.HEALTHY
+    assert lad.dwell_s("device_lost") == pytest.approx(3.0)
+    st = lad.stats()
+    assert st["dss_degraded_mode"] == 0.0
+    assert st["dss_degraded_transitions"] == 6.0
+
+
+def test_ladder_rejects_unknown_condition():
+    lad = chaos.DegradationLadder()
+    with pytest.raises(ValueError):
+        lad.enter("flux_capacitor")
+
+
+# -- planner under DEVICE_LOST ----------------------------------------------
+
+
+def test_planner_device_lost_inadmissibility():
+    from dss_tpu.plan import BatchShape
+    from dss_tpu.plan.planner import (
+        decide,
+        enumerate_candidates,
+        plan_drain_cap,
+        state_of,
+    )
+    from dss_tpu.plan.costs import CostModel
+
+    cost = CostModel(floor_ms=20.0, item_ms=0.02, chunk_ms=0.3)
+    lost = state_of(
+        cost, resident_ready=True, mesh_ready=True, device_ok=False
+    )
+    shape = BatchShape(n=128, all_stale=True)
+    cand = enumerate_candidates(shape, lost, None)
+    assert cand["device"] is None
+    assert cand["resident"] is None
+    assert cand["mesh"] is None  # the mesh is local device compute
+    assert cand["hostchunk"] is not None
+
+    # bulk and deadline drains both land on the host
+    assert decide(shape, lost, None).route == "hostchunk"
+    assert decide(BatchShape(n=128), lost, 50.0).route == "hostchunk"
+    # lone small caller keeps the inline exact path
+    assert decide(
+        BatchShape(n=4, inline=True), lost, 50.0
+    ).route == "inline"
+    # inline under host_only (event loop) still picks inline, never a
+    # device candidate that does not exist
+    only = state_of(cost, device_ok=False, host_only=True)
+    assert decide(
+        BatchShape(n=4, inline=True), only, 50.0
+    ).route == "inline"
+
+    # drain caps size against the host when the device class is gone
+    healthy = state_of(cost, device_ok=True)
+    assert plan_drain_cap(512, 1000.0, healthy) == 512
+    capped = plan_drain_cap(512, 10.0, lost)
+    assert capped <= 512  # host sizing engaged, never the AIMD bypass
+
+    # default is unchanged: device_ok=True reproduces the old policy
+    assert decide(shape, state_of(cost), None).route == "device"
+
+
+# -- coalescer absorbs device loss -------------------------------------------
+
+
+class _FakePq:
+    def __init__(self, results, fail=False):
+        self._results = results
+        self._fail = fail
+
+    def wait_device(self):
+        if self._fail:
+            raise chaos.DeviceLostError("device.dispatch", "mid-flight")
+
+    def used_device(self):
+        return not self._fail
+
+
+class _FakeTable:
+    """query_many_submit/collect pair the coalescer drives; host_route
+    submissions always succeed (the pure-host path)."""
+
+    def __init__(self):
+        self.host_batches = 0
+        self.device_batches = 0
+        self.fail_next_collect = False
+
+    def _answers(self, keys_list):
+        return [[f"id{int(k[0])}"] for k in keys_list]
+
+    def query_many_submit(self, keys_list, lo, hi, t0s, t1s, *, now,
+                          owner_ids=None, host_route=False, kernel=None):
+        if host_route:
+            self.host_batches += 1
+            return _FakePq(self._answers(keys_list))
+        self.device_batches += 1
+        fail = self.fail_next_collect
+        self.fail_next_collect = False
+        return _FakePq(self._answers(keys_list), fail=fail)
+
+    def query_many_collect(self, pq):
+        pq.wait_device()
+        return pq._results
+
+
+def _mk_coalescer(table, **kw):
+    from dss_tpu.dar.coalesce import QueryCoalescer
+
+    kw.setdefault("min_batch", 1)
+    kw.setdefault("inline", False)
+    # device strongly preferred so the plan is deterministic
+    kw.setdefault("est_floor_ms", 0.01)
+    kw.setdefault("est_chunk_ms", 1000.0)
+    return QueryCoalescer(table, **kw)
+
+
+def test_coalescer_absorbs_injected_dispatch_loss():
+    """An injected device loss at the cold dispatch seam: the batch is
+    re-served as host chunks, callers get correct answers (no error),
+    the ladder flips DEVICE_LOST, and the planner stops offering the
+    device class until recovery."""
+    table = _FakeTable()
+    co = _mk_coalescer(table)
+    lad = chaos.DegradationLadder()
+    co.set_health(lad)
+    chaos.install_plan(
+        {"events": [{"site": "device.dispatch",
+                     "action": "device_lost", "count": 1}]}
+    )
+    res = co.query(
+        np.asarray([7], np.int32), None, None, None, None, now=0,
+        allow_stale=True,
+    )
+    assert res == ["id7"]  # absorbed: the caller never saw the loss
+    assert lad.is_active("device_lost")
+    assert table.host_batches >= 1
+    st = co.stats()
+    assert st["co_device_loss_absorbed"] == 1
+    assert st["co_device_ok"] == 0
+    assert not co._capture_state().device_ok
+
+    # while DEVICE_LOST, new batches plan hostward (no device submits)
+    dev_before = table.device_batches
+    res = co.query(
+        np.asarray([9], np.int32), None, None, None, None, now=0,
+        allow_stale=True,
+    )
+    assert res == ["id9"]
+    assert table.device_batches == dev_before
+
+    # recovery re-admits the device class
+    lad.exit("device_lost")
+    assert co.stats()["co_device_ok"] == 1
+    res = co.query(
+        np.asarray([3], np.int32), None, None, None, None, now=0,
+        allow_stale=True,
+    )
+    assert res == ["id3"]
+    assert table.device_batches == dev_before + 1
+    co.close()
+
+
+def test_coalescer_absorbs_collect_stage_loss():
+    """Device loss AFTER submit (the in-flight batch's wait fails):
+    the collect stage re-runs the batch on the host — the admitted
+    caller still resolves with the right answer."""
+    table = _FakeTable()
+    table.fail_next_collect = True
+    co = _mk_coalescer(table)
+    lad = chaos.DegradationLadder()
+    co.set_health(lad)
+    res = co.query(
+        np.asarray([5], np.int32), None, None, None, None, now=0,
+        allow_stale=True,
+    )
+    assert res == ["id5"]
+    assert lad.is_active("device_lost")
+    assert co.stats()["co_device_loss_absorbed"] == 1
+    assert table.host_batches == 1
+    co.close()
+
+
+def test_coalescer_delivers_non_loss_errors_unchanged():
+    """Only device-loss shapes are absorbed: an ordinary failure still
+    surfaces to the caller (no silent retry of arbitrary errors)."""
+    table = _FakeTable()
+    co = _mk_coalescer(table)
+    chaos.install_plan(
+        {"events": [{"site": "device.dispatch", "action": "error",
+                     "count": 1}]}
+    )
+    with pytest.raises(chaos.FaultError):
+        co.query(
+            np.asarray([1], np.int32), None, None, None, None, now=0,
+            allow_stale=True,
+        )
+    co.close()
+
+
+# -- region client: shared policy + breakers + ladder ------------------------
+
+
+class _FakeResponse:
+    def __init__(self, status=200, body=None):
+        self.status_code = status
+        self._body = body or {}
+        self.text = "x"
+
+    def json(self):
+        return self._body
+
+
+class _FakeSession:
+    """Scripted per-endpoint transport for RegionClient."""
+
+    def __init__(self, behavior):
+        # url-prefix -> callable() -> _FakeResponse (or raises)
+        self.behavior = behavior
+        self.headers = {}
+        self.calls = []
+
+    def request(self, method, url, timeout=None, **kw):
+        self.calls.append(url)
+        for prefix, fn in self.behavior.items():
+            if url.startswith(prefix):
+                return fn()
+        raise AssertionError(f"unscripted url {url}")
+
+
+def _conn_err():
+    import requests
+
+    raise requests.ConnectionError("refused")
+
+
+def test_client_failover_prefers_closed_breakers():
+    from dss_tpu.region.client import RegionClient
+
+    c = RegionClient(
+        "http://a:1,http://b:1", "i", retry_deadline_s=5.0,
+        max_retries=4,
+    )
+    c._retry_policy = chaos.RetryPolicy(base_s=0.0, cap_s=0.0)
+    sess = _FakeSession({
+        "http://a:1": _conn_err,
+        "http://b:1": lambda: _FakeResponse(200, {"head": 3}),
+    })
+    c._session = sess
+    # first call fails over a -> b and succeeds
+    r = c._request("GET", "/records")
+    assert r.status_code == 200
+    states = c.breaker_states()
+    assert states["http://b:1"] == chaos.BREAKER_CLOSED
+    # burn a's breaker open, then verify fresh calls go straight to b
+    for _ in range(4):
+        try:
+            c._active = 0
+            c._request("GET", "/records")
+        except Exception:
+            pass
+    assert c.breaker_states()["http://a:1"] == chaos.BREAKER_OPEN
+    c._active = 1  # active endpoint is b after the failovers
+    sess.calls.clear()
+    assert c._request("GET", "/records").status_code == 200
+    assert all(u.startswith("http://b:1") for u in sess.calls)
+
+
+def test_client_outage_drives_ladder_and_retry_after():
+    from dss_tpu.region.client import RegionClient, RegionError
+
+    lad = chaos.DegradationLadder()
+    c = RegionClient(
+        "http://a:1", "i", retry_deadline_s=0.2, max_retries=1,
+        health=lad,
+    )
+    c._retry_policy = chaos.RetryPolicy(base_s=0.0, cap_s=0.0)
+    c._session = _FakeSession({"http://a:1": _conn_err})
+    # enough failed calls to open the only breaker (threshold 3)
+    for _ in range(3):
+        with pytest.raises(RegionError):
+            c._request("GET", "/records")
+    assert lad.is_active("region_log_down")
+    assert lad.mode() == chaos.REGION_LOG_DOWN
+    assert c.retry_after_s() >= 0.5
+    # recovery: one success walks the ladder back down
+    c._session = _FakeSession(
+        {"http://a:1": lambda: _FakeResponse(200, {"head": 0})}
+    )
+    c._request("GET", "/records")
+    assert not lad.is_active("region_log_down")
+    assert lad.mode() == chaos.HEALTHY
+
+
+def test_client_injected_partition_retries_like_transport():
+    from dss_tpu.region.client import RegionClient
+
+    c = RegionClient("http://a:1", "i", retry_deadline_s=5.0)
+    c._retry_policy = chaos.RetryPolicy(base_s=0.0, cap_s=0.0)
+    c._session = _FakeSession(
+        {"http://a:1": lambda: _FakeResponse(200, {"head": 0})}
+    )
+    chaos.install_plan(
+        {"events": [{"site": "region.client.request",
+                     "action": "partition", "count": 2}]}
+    )
+    # two injected partitions, then the transport recovers: the call
+    # succeeds without surfacing anything
+    assert c._request("GET", "/records").status_code == 200
+    assert chaos.registry().injected_by_site()[
+        "region.client.request"
+    ] == 2
+
+
+# -- coordinator conflict cool-down ------------------------------------------
+
+
+class _StubRegionClient:
+    lease_ttl_s = 10.0
+
+    def retry_after_s(self):
+        return 2.5
+
+    def release_lease(self, token):
+        pass
+
+
+def _mk_coordinator(cap=2.0):
+    from dss_tpu.region.coordinator import RegionCoordinator
+
+    return RegionCoordinator(
+        _StubRegionClient(), None, None, threading.RLock(),
+        conflict_backoff_s=cap,
+    )
+
+
+def test_conflict_backoff_jittered_growing_capped():
+    coord = _mk_coordinator(cap=2.0)
+    d0 = coord._conflict_cooldown_s()
+    assert 0.25 <= d0 <= 0.75  # base 0.5, jitter +/-50%
+    d1 = coord._conflict_cooldown_s()
+    assert 0.5 <= d1 <= 1.5  # doubled
+    # the streak caps (never exceeds cap * (1+jitter))
+    draws = [coord._conflict_cooldown_s() for _ in range(16)]
+    assert all(d <= 2.0 * 1.5 + 1e-9 for d in draws)
+    assert all(d >= 2.0 * 0.5 - 1e-9 for d in draws[2:])
+    # colliding coordinators cannot re-collide in lockstep: repeated
+    # draws at the same streak depth are not one constant
+    assert len({round(d, 6) for d in draws}) > 1
+    # a successful optimistic commit resets the streak
+    coord._conflict_streak = 0
+    assert coord._conflict_cooldown_s() <= 0.75
+
+
+def test_coordinator_unavailable_carries_retry_after():
+    coord = _mk_coordinator()
+    e = coord._unavailable("region log down")
+    assert isinstance(e, errors.StatusError)
+    assert e.http_status == 503
+    assert e.retry_after_s == 2.5
+
+
+# -- mirror sender backoff ---------------------------------------------------
+
+
+def test_mirror_sender_backoff_capped_and_exported():
+    from dss_tpu.region import mirror as mirror_mod
+    from dss_tpu.region.log_server import RegionLog
+    from dss_tpu.region.mirror import RegionNode, _MirrorPeer
+
+    pol = mirror_mod._SENDER_BACKOFF
+    # fails=1 draws the base; deep fail streaks cap at 2.0 (+jitter)
+    assert 0.05 <= pol.backoff_s(0) <= 0.15
+    for k in range(12):
+        assert pol.backoff_s(k) <= 2.0 * 1.5 + 1e-9
+
+    node = RegionNode(RegionLog(None))
+    m = _MirrorPeer("http://m", 0, epoch=node.log.epoch)
+    m.backoff_s = 1.25
+    node.mirrors = {m.url: m}
+    text = node.render_metrics()
+    assert "region_mirror_backoff_s 1.25" in text
+    assert node.status()["mirrors"]["http://m"]["backoff_s"] == 1.25
+
+
+# -- wal fault sites ---------------------------------------------------------
+
+
+def test_wal_append_fault_leaves_log_consistent(tmp_path):
+    from dss_tpu.dar.wal import WriteAheadLog
+
+    wal = WriteAheadLog(str(tmp_path / "w.log"))
+    chaos.install_plan(
+        {"events": [{"site": "wal.append", "count": 1}]}
+    )
+    with pytest.raises(chaos.FaultError):
+        wal.append({"t": "x"})
+    # the injected failure happened BEFORE any bytes or seq: the next
+    # append is record 1 and replay sees exactly one record
+    assert wal.append({"t": "y"}) == 1
+    wal.close()
+    wal2 = WriteAheadLog(str(tmp_path / "w.log"))
+    assert [r["t"] for r in wal2.replay()] == ["y"]
+    wal2.close()
+
+
+def test_wal_fsync_stall_injection(tmp_path):
+    from dss_tpu.dar.wal import WriteAheadLog
+
+    wal = WriteAheadLog(str(tmp_path / "w.log"), fsync=True)
+    chaos.install_plan(
+        {"events": [{"site": "wal.fsync", "action": "delay",
+                     "delay_s": 0.05, "count": 1}]}
+    )
+    t0 = time.perf_counter()
+    wal.append({"t": "x"})
+    stalled = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    wal.append({"t": "y"})
+    clean = time.perf_counter() - t0
+    assert stalled >= 0.045 and stalled > clean
+    assert chaos.registry().injected_by_site()["wal.fsync"] == 1
+    wal.close()
+
+
+# -- store surface -----------------------------------------------------------
+
+
+def test_store_exports_health_and_fault_gauges():
+    from dss_tpu.dar.dss_store import DSSStore
+
+    store = DSSStore(storage="memory")
+    try:
+        st = store.stats()
+        assert st["dss_degraded_mode"] == 0.0
+        assert st["dss_breaker_state"] == {}
+        assert isinstance(st["dss_fault_injected_total"], dict)
+        fs = store.freshness_status()
+        assert fs["degraded_mode"] == "healthy"
+        assert fs["degraded"] == {}
+
+        store.health.enter("device_lost", "injected")
+        assert store.stats()["dss_degraded_mode"] == 1.0
+        fs = store.freshness_status()
+        assert fs["degraded_mode"] == "device_lost"
+        assert fs["degraded"]["device_lost"]["reason"] == "injected"
+        store.health.exit("device_lost")
+    finally:
+        store.close()
+
+
+def test_cache_populate_fault_degrades_to_miss(monkeypatch):
+    """An injected cache-population failure must cost a future miss,
+    never a wrong or failed answer."""
+    from datetime import datetime, timedelta, timezone
+
+    monkeypatch.setenv("DSS_CACHE_ENABLE", "1")
+    from dss_tpu.dar.dss_store import DSSStore
+
+    store = DSSStore(storage="memory")
+    try:
+        import uuid
+
+        from dss_tpu.geo.covering import canonical_cells
+        from dss_tpu.models import rid as ridm
+
+        now = datetime.now(timezone.utc)
+        isa = ridm.IdentificationServiceArea(
+            id=str(uuid.uuid4()), owner="u1", url="https://u/f",
+            cells=np.asarray([123], np.uint64),
+            altitude_lo=0.0, altitude_hi=100.0,
+            start_time=now - timedelta(minutes=1),
+            end_time=now + timedelta(hours=1),
+            version=None,
+        )
+        assert store.rid.insert_isa(isa) is not None
+        cells = canonical_cells(np.asarray([123], np.uint64))
+        chaos.install_plan(
+            {"events": [{"site": "cache.populate", "count": 1}]}
+        )
+        a = [x.id for x in store.rid.search_isas(cells, now, None)]
+        assert a == [isa.id]  # the answer survived the injection
+        st0 = store.cache.stats()
+        assert st0["entries"] == 0  # population was dropped
+        # next search is a miss again, then populates normally
+        b = [x.id for x in store.rid.search_isas(cells, now, None)]
+        assert b == a
+        assert store.cache.stats()["entries"] == 1
+    finally:
+        store.close()

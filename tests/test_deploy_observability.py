@@ -534,6 +534,65 @@ def test_grafana_and_rules_cover_shard_placement():
     )
 
 
+def test_grafana_and_rules_cover_degradation():
+    """The fault-injection + degradation-ladder subsystem must stay
+    observable: a dashboard panel over dss_degraded_mode /
+    dss_breaker_state{remote} / dss_fault_injected_total{site} /
+    region_mirror_backoff_s, plus the DssDegradedMode page and the
+    DssBreakerOpen warning registered in the alert rules."""
+    dash = json.load(
+        open(os.path.join(ROOT, "deploy/grafana/dss-dashboard.json"))
+    )
+    exprs = [
+        t["expr"]
+        for p in dash["panels"]
+        for t in p.get("targets", [])
+    ]
+    for needed in (
+        "dss_degraded_mode",
+        "dss_breaker_state",
+        "dss_fault_injected_total",
+        "dss_degraded_transitions",
+        "co_device_loss_absorbed",
+        "co_device_ok",
+        "region_mirror_backoff_s",
+    ):
+        assert any(needed in e for e in exprs), needed
+    rules = yaml.safe_load(
+        open(os.path.join(ROOT, "deploy/prometheus/rules.yaml"))
+    )
+    alerts = {
+        r.get("alert"): r["expr"]
+        for g in rules["groups"]
+        for r in g["rules"]
+    }
+    assert "DssDegradedMode" in alerts
+    assert "dss_degraded_mode" in alerts["DssDegradedMode"]
+    assert "DssBreakerOpen" in alerts
+    assert "dss_breaker_state" in alerts["DssBreakerOpen"]
+
+
+def test_degradation_gauges_render_as_labeled_families():
+    """dss_breaker_state and dss_fault_injected_total are keyed gauge
+    families with their OWN label names (remote / site), routed through
+    the metrics handler's per-metric label map."""
+    from dss_tpu.api.app import _GAUGE_VEC_LABELS
+    from dss_tpu.obs.metrics import MetricsRegistry
+
+    assert _GAUGE_VEC_LABELS["dss_breaker_state"] == "remote"
+    assert _GAUGE_VEC_LABELS["dss_fault_injected_total"] == "site"
+    reg = MetricsRegistry()
+    reg.set_gauge_vec(
+        "dss_breaker_state", "remote", {"http://a:1": 2.0}
+    )
+    reg.set_gauge_vec(
+        "dss_fault_injected_total", "site", {"wal.fsync": 3.0}
+    )
+    text = reg.render()
+    assert 'dss_breaker_state{remote="http://a:1"} 2.0' in text
+    assert 'dss_fault_injected_total{site="wal.fsync"} 3.0' in text
+
+
 def test_shard_gauges_render_as_labeled_family():
     """dss_shard_load is a per-shard labeled gauge family: the /metrics
     exposition must carry one series per shard so the heat panel can

@@ -753,6 +753,128 @@ def test_promotion_fences_stale_primary(cluster):
     assert st["role"] == "mirror"
 
 
+def test_promotion_fencing_under_replicate_flaps(cluster):
+    """ISSUE 11 satellite: a seeded FaultPlan drops, then delays, the
+    primary's /replicate pushes while writes land and a promotion runs
+    — the epoch rules must hold exactly as on a clean link.  Every
+    write ACKED through the flap window is durable on the max-head
+    mirror (quorum acks require contiguous durable appends, flaps or
+    not), the promoted mirror fences the zombie primary, and after the
+    link heals the full acked history — and nothing the region never
+    acked — serves from the new lineage."""
+    import requests
+
+    from dss_tpu import chaos
+
+    primary, m1, m2, _ = cluster
+    chaos.clear_plan()
+    chaos.registry().reset_counters()
+    c = RegionClient(
+        [primary.url, m1.url, m2.url], "writer", retry_deadline_s=8.0,
+        max_retries=6,
+    )
+    for i in range(3):
+        tok, _ = c.acquire_lease()
+        assert c.append(tok, [{"t": "pre", "i": i}], release=True) == i
+    wait_head(m1.url, 3)
+    wait_head(m2.url, 3)
+    old_epoch = c._seen_epoch
+    acked = 3
+
+    # the flap: first DROP pushes (sender loop error -> shared-policy
+    # backoff), then DELAY them (slow link) — matched to /replicate
+    # only, so mirror heartbeats keep flowing
+    chaos.install_plan(
+        {"seed": 5, "events": [
+            {"site": "region.mirror.replicate", "match": "/replicate",
+             "action": "error", "count": 4},
+            {"site": "region.mirror.replicate", "match": "/replicate",
+             "action": "delay", "delay_s": 0.15, "after": 4,
+             "count": 6},
+        ]}
+    )
+    try:
+        flap_acked = []
+        for i in range(3):
+            try:
+                tok, _ = c.acquire_lease()
+                idx = c.append(
+                    tok, [{"t": "flap", "i": i}], release=True
+                )
+                flap_acked.append(idx)
+                acked = idx + 1
+            except RegionError:
+                # quorum timeout mid-flap: honestly NOT acked — the
+                # writer rolled back, and the entry may or may not be
+                # on the old primary's (soon-fenced) log
+                pass
+        assert flap_acked, "no write acked through the flap window"
+        assert chaos.registry().injected_by_site().get(
+            "region.mirror.replicate", 0
+        ) >= 4
+
+        # the runbook under fire: promote the MAX-HEAD mirror —
+        # contiguous-ack quorum means it provably holds every acked
+        # write even though pushes were being dropped
+        heads = {
+            m: requests.get(f"{m.url}/status", timeout=5).json()["head"]
+            for m in (m1, m2)
+        }
+        best = m1 if heads[m1] >= heads[m2] else m2
+        other = m2 if best is m1 else m1
+        assert heads[best] >= acked, (
+            "max-head mirror is missing acked writes", heads, acked,
+        )
+        out = requests.post(
+            f"{best.url}/promote", json={}, timeout=5
+        ).json()
+        assert out["role"] == "primary"
+        assert epoch_gen(out["epoch"]) == epoch_gen(old_epoch) + 1
+        r = requests.post(
+            f"{other.url}/repoint", json={"primary": best.url},
+            timeout=5,
+        )
+        assert r.status_code == 200
+
+        # zombie fenced: the old primary's next push (once it gets
+        # through the flap) is refused stale_epoch by the promoted
+        # mirror and it demotes itself — its un-acked suffix dies with
+        # it
+        stale = RegionClient(
+            primary.url, "stale", retry_deadline_s=0.5, max_retries=1
+        )
+        stale._epoch = old_epoch
+        try:
+            tok2, _ = stale.acquire_lease()
+            stale.append(tok2, [{"t": "lost"}], release=True)
+        except RegionError:
+            pass  # already refusing: also fenced
+        wait_until(
+            lambda: (
+                requests.get(
+                    f"{primary.url}/status", timeout=5
+                ).json()["role"] == "demoted"
+            ) or None
+        )
+    finally:
+        chaos.clear_plan()
+        chaos.registry().reset_counters()
+
+    # the link healed: client fails over, adopts the promotion epoch,
+    # and the acked history is intact under the new lineage
+    with pytest.raises(EpochChanged):
+        c.fetch(0)
+    c.adopt_epoch()
+    tok3, head = c.acquire_lease()
+    assert head >= acked
+    assert c.append(tok3, [{"t": "post"}], release=True) == head
+    entries, _h = c.fetch(0)
+    types = [e[1][0]["t"] for e in entries]
+    assert types[:3] == ["pre"] * 3
+    assert "lost" not in types  # never acked, never served
+    assert sum(1 for t in types if t == "flap") >= len(flap_acked)
+
+
 def test_promote_refuses_behind_min_head(cluster):
     import requests
 

@@ -30,7 +30,7 @@ from datetime import datetime, timedelta, timezone
 import numpy as np
 import pytest
 
-from dss_tpu import errors
+from dss_tpu import chaos, errors
 from dss_tpu.dar.dss_store import DSSStore
 from dss_tpu.services.rid import RIDService
 from dss_tpu.services.scd import SCDService
@@ -406,3 +406,254 @@ def test_backends_agree_under_random_ops(seed, monkeypatch):
         assert stores[n].cache.stats()["hits"] == 0
     for s in stores.values():
         s.close()
+
+
+@pytest.mark.parametrize("seed", [3, 11])
+def test_fuzz_with_fault_schedule(seed, monkeypatch):
+    """The fault-schedule dimension (ISSUE 11): a SEEDED FaultPlan is
+    injected mid-sequence against the tpu store — device loss at the
+    dispatch seam, dropped cache populations — while the memory store
+    (uncached, deviceless: no instrumented seam fires there) runs as
+    the no-fault oracle.  Every outcome must stay identical THROUGH
+    the fault window (the coalescer absorbs device loss onto the host
+    route; population failures degrade to misses), and after the plan
+    clears and the degradation ladder walks back down, a full search
+    sweep must be bit-identical to the oracle with zero acked-write
+    loss (every write acked during the window is still served)."""
+    chaos.clear_plan()
+    chaos.registry().reset_counters()
+    monkeypatch.setenv("DSS_CACHE_ENABLE", "1")
+    monkeypatch.setenv("DSS_CACHE_CAP", "512")
+    monkeypatch.setenv("DSS_TIER_RATIO", "5")
+    tpu = DSSStore(storage="tpu")
+    monkeypatch.setenv("DSS_CACHE_ENABLE", "0")
+    mem = DSSStore(storage="memory")
+    stores = {"memory": mem, "tpu": tpu}
+    rid = {n: RIDService(s.rid, s.clock) for n, s in stores.items()}
+    scd = {n: SCDService(s.scd, s.clock) for n, s in stores.items()}
+    rng = np.random.default_rng(seed)
+    isa_versions: dict = {n: {} for n in stores}
+    op_ovns: dict = {n: {} for n in stores}
+    acked_isas: set = set()  # ids acked DURING the fault window
+
+    plan = chaos.FaultPlan.from_dict(
+        {
+            "seed": seed,
+            "events": [
+                # two device-loss episodes: the first mid-window hit,
+                # another after a few more dispatch attempts
+                {"site": "device.dispatch", "action": "device_lost",
+                 "count": 2},
+                {"site": "device.dispatch", "action": "device_lost",
+                 "after": 5, "count": 2},
+                # dropped cache populations (best-effort contract)
+                {"site": "cache.populate", "action": "error",
+                 "count": 3},
+                # and a deterministic thinning of later populations
+                {"site": "cache.populate", "action": "error",
+                 "after": 3, "count": 4, "p": 0.5},
+            ],
+        }
+    )
+
+    try:
+        for step in range(72):
+            if step == 12:
+                chaos.install_plan(plan)  # fault window opens
+            if step == 56:
+                # fault clearance + explicit recovery: the ladder
+                # walks back down (re-warm runs before re-admission)
+                chaos.clear_plan()
+                tpu.health.exit("device_lost")
+            in_window = 12 <= step < 56
+            op = rng.integers(0, 6)
+            sid = str(uuid.UUID(int=int(rng.integers(0, 24)), version=4))
+            if op == 0:  # ISA create
+                create_id = (
+                    str(uuid.UUID(int=int(rng.integers(1000, 2000)),
+                                  version=4))
+                    if sid in isa_versions["memory"]
+                    else sid
+                )
+                body = {
+                    "extents": _extents(rng),
+                    "flights_url": "https://u/f",
+                }
+                outs = {
+                    n: _norm_outcome(
+                        rid[n].create_isa, create_id, body, "u1"
+                    )
+                    for n in stores
+                }
+            elif op == 1:  # ISA delete
+                outs = {
+                    n: _norm_outcome(
+                        rid[n].delete_isa, sid,
+                        isa_versions[n].get(sid, "aaaaaaaaaa"), "u1",
+                    )
+                    for n in stores
+                }
+            elif op in (2, 3):  # RID search (the device-route seam)
+                area = _search_area(rng)
+                outs = {
+                    n: _norm_outcome(rid[n].search_isas, area)
+                    for n in stores
+                }
+            elif op == 4:  # SCD op put
+                ext = _extents(rng)
+                body = {
+                    "extents": [
+                        {
+                            "volume": {
+                                "outline_polygon": ext[
+                                    "spatial_volume"
+                                ]["footprint"],
+                                "altitude_lower": {
+                                    "value": 50.0, "reference": "W84",
+                                    "units": "M",
+                                },
+                                "altitude_upper": {
+                                    "value": 200.0, "reference": "W84",
+                                    "units": "M",
+                                },
+                            },
+                            "time_start": {
+                                "value": ext["time_start"],
+                                "format": "RFC3339",
+                            },
+                            "time_end": {
+                                "value": ext["time_end"],
+                                "format": "RFC3339",
+                            },
+                        }
+                    ],
+                    "uss_base_url": "https://u.example",
+                    "new_subscription": {
+                        "uss_base_url": "https://u.example"
+                    },
+                    "state": "Accepted",
+                    "old_version": 0,
+                }
+                outs = {
+                    n: _norm_outcome(
+                        scd[n].put_operation, sid,
+                        dict(body, key=list(op_ovns[n].values())), "u1",
+                    )
+                    for n in stores
+                }
+            else:  # SCD search
+                ext = _extents(rng)
+                aoi = {
+                    "area_of_interest": {
+                        "volume": {
+                            "outline_polygon": ext["spatial_volume"][
+                                "footprint"
+                            ],
+                        },
+                        "time_start": {
+                            "value": ext["time_start"],
+                            "format": "RFC3339",
+                        },
+                        "time_end": {
+                            "value": ext["time_end"],
+                            "format": "RFC3339",
+                        },
+                    }
+                }
+                outs = {
+                    n: _norm_outcome(scd[n].search_operations, aoi, "u1")
+                    for n in stores
+                }
+
+            mem_out = outs["memory"]
+            assert mem_out[0] == outs["tpu"][0], (
+                step, op, mem_out, outs["tpu"],
+            )
+            if mem_out[0] == "err":
+                assert mem_out[1:] == outs["tpu"][1:], (step, op, outs)
+                continue
+            res = {n: o[1] for n, o in outs.items()}
+            if op in (2, 3):
+                ids = {
+                    n: sorted(s["id"] for s in r["service_areas"])
+                    for n, r in res.items()
+                }
+                assert ids["tpu"] == ids["memory"], (step, ids)
+            elif op == 5:
+                ids = {
+                    n: sorted(
+                        o["id"] for o in r["operation_references"]
+                    )
+                    for n, r in res.items()
+                }
+                assert ids["tpu"] == ids["memory"], (step, ids)
+            elif op == 0:
+                for n, r in res.items():
+                    isa_versions[n][r["service_area"]["id"]] = r[
+                        "service_area"
+                    ]["version"]
+                if in_window:
+                    acked_isas.add(res["memory"]["service_area"]["id"])
+            elif op == 1:
+                for m in isa_versions.values():
+                    m.pop(sid, None)
+                acked_isas.discard(sid)
+            elif op == 4:
+                for n, r in res.items():
+                    op_ovns[n][sid] = r["operation_reference"]["ovn"]
+
+            if step % 8 == 7:
+                # folds/compactions mid-window: recovery state must be
+                # identical across the tier churn too
+                major = (step // 8) % 2 == 1
+                for n in stores:
+                    for t in _index_tables(stores[n]):
+                        if major:
+                            t.compact()
+                        else:
+                            t.fold()
+
+        # the schedule actually exercised both seams, and the absorbed
+        # device losses never surfaced (all outcomes matched above)
+        injected = chaos.registry().injected_by_site()
+        assert injected.get("device.dispatch", 0) >= 1, injected
+        assert injected.get("cache.populate", 0) >= 1, injected
+        # recovery: ladder fully walked down
+        assert tpu.health.mode() == chaos.HEALTHY
+
+        # post-recovery sweep: bit-identical to the no-fault oracle
+        # across every quantized poll area; zero acked-write loss (the
+        # writes acked during the window are still served)
+        seen_tpu: set = set()
+        for i in range(6):
+            for j in range(6):
+                for h in (0.02, 0.045):
+                    lat = BASE_LAT + 0.05 * i
+                    lng = BASE_LNG + 0.05 * j
+                    area = (
+                        f"{lat},{lng},{lat + h},{lng},"
+                        f"{lat + h},{lng + h},{lat},{lng + h}"
+                    )
+                    a = _norm_outcome(rid["memory"].search_isas, area)
+                    b = _norm_outcome(rid["tpu"].search_isas, area)
+                    assert a[0] == b[0] == "ok", (area, a, b)
+                    am = sorted(
+                        s["id"] for s in a[1]["service_areas"]
+                    )
+                    bm = sorted(
+                        s["id"] for s in b[1]["service_areas"]
+                    )
+                    assert am == bm, (area, am, bm)
+                    seen_tpu.update(bm)
+        still_live = {
+            i for i in acked_isas if i in isa_versions["memory"]
+        }
+        assert still_live <= seen_tpu, (
+            "acked-write loss after recovery",
+            still_live - seen_tpu,
+        )
+    finally:
+        chaos.clear_plan()
+        chaos.registry().reset_counters()
+        for s in stores.values():
+            s.close()
