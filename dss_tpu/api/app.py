@@ -665,6 +665,10 @@ def build_app(
                 "scd_subs", _SCD + "QuerySubscriptions",
                 "subscription_ids", True,
             ),
+            "constraints": (
+                "constraints", _SCD + "QueryConstraintReferences",
+                "constraint_ids", False,
+            ),
         }
 
         async def replica_search(request):
@@ -898,29 +902,36 @@ def build_app(
             )
 
         async def constraint_put(request):
-            auth(request, _SCD + "PutConstraintReference")
+            owner = auth(request, _SCD + "PutConstraintReference")
             return web.json_response(
-                await _call_r(request, scd.put_constraint, 
-                    request.match_info["entityuuid"], await _params(request)
+                await _call_r(request, scd.put_constraint,
+                    request.match_info["entityuuid"],
+                    await _params(request),
+                    owner,
                 )
             )
 
         async def constraint_get(request):
-            auth(request, _SCD + "GetConstraintReference")
+            owner = auth(request, _SCD + "GetConstraintReference")
             return web.json_response(
-                await _call_r(request, scd.get_constraint, request.match_info["entityuuid"])
+                await _call_read(request, scd.get_constraint,
+                    request.match_info["entityuuid"], owner
+                )
             )
 
         async def constraint_delete(request):
-            auth(request, _SCD + "DeleteConstraintReference")
+            owner = auth(request, _SCD + "DeleteConstraintReference")
             return web.json_response(
-                await _call_r(request, scd.delete_constraint, request.match_info["entityuuid"])
+                await _call_r(request, scd.delete_constraint,
+                    request.match_info["entityuuid"], owner
+                )
             )
 
         async def constraint_query(request):
-            auth(request, _SCD + "QueryConstraintReferences")
-            return web.json_response(
-                await _call_read(request, scd.query_constraints, await _params(request))
+            owner = auth(request, _SCD + "QueryConstraintReferences")
+            return _freshness_json_response(
+                request,
+                await _call_read(request, scd.query_constraints, await _params(request), owner),
             )
 
         async def dss_report(request):

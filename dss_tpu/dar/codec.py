@@ -104,6 +104,7 @@ def op_to_doc(o: scdm.Operation) -> dict:
         "state": o.state,
         "cells": _cells(o.cells),
         "subscription_id": o.subscription_id,
+        "constraint_aware": o.constraint_aware,
     }
 
 
@@ -121,6 +122,37 @@ def doc_to_op(d: dict) -> scdm.Operation:
         state=d.get("state", ""),
         cells=_uncells(d.get("cells")),
         subscription_id=d.get("subscription_id", ""),
+        constraint_aware=d.get("constraint_aware", False),
+    )
+
+
+def constraint_to_doc(c: scdm.Constraint) -> dict:
+    return {
+        "id": c.id,
+        "owner": c.owner,
+        "version": c.version,
+        "ovn": c.ovn,
+        "start_time": _t(c.start_time),
+        "end_time": _t(c.end_time),
+        "altitude_lower": c.altitude_lower,
+        "altitude_upper": c.altitude_upper,
+        "uss_base_url": c.uss_base_url,
+        "cells": _cells(c.cells),
+    }
+
+
+def doc_to_constraint(d: dict) -> scdm.Constraint:
+    return scdm.Constraint(
+        id=d["id"],
+        owner=d["owner"],
+        version=d.get("version", 0),
+        ovn=d.get("ovn", ""),
+        start_time=_dt(d.get("start_time")),
+        end_time=_dt(d.get("end_time")),
+        altitude_lower=d.get("altitude_lower"),
+        altitude_upper=d.get("altitude_upper"),
+        uss_base_url=d.get("uss_base_url", ""),
+        cells=_uncells(d.get("cells")),
     )
 
 

@@ -589,6 +589,9 @@ class SCDStoreImpl(_TxnTimeMixin, _CachedSearchMixin, SCDStore):
     def sub_index_stats(self) -> dict:
         return self._sub_index.stats()
 
+    def cst_index_stats(self) -> dict:
+        return self._cst_index.stats()
+
     def __init__(
         self, *, clock, ts_oracle, owners, lock, journal, index_factory,
         txn=None, capture_undo=False, cache=None, epoch_fn=None,
@@ -605,19 +608,23 @@ class SCDStoreImpl(_TxnTimeMixin, _CachedSearchMixin, SCDStore):
         self._init_cache(cache, epoch_fn)
         self._ops: Dict[str, scdm.Operation] = {}
         self._subs: Dict[str, scdm.Subscription] = {}
+        self._csts: Dict[str, scdm.Constraint] = {}
         self._op_index = index_factory()
         self._sub_index = index_factory()
+        self._cst_index = index_factory()
 
     def reset_state(self):
         """Drop all local state (region resync rebuilds from the log);
         _fenced_index_swap keeps the cache coherent — see RIDStoreImpl."""
-        new_op, new_sub = self._fenced_index_swap(
-            self._op_index, self._sub_index
+        new_op, new_sub, new_cst = self._fenced_index_swap(
+            self._op_index, self._sub_index, self._cst_index
         )
         self._ops = {}
         self._subs = {}
+        self._csts = {}
         self._op_index = new_op
         self._sub_index = new_sub
+        self._cst_index = new_cst
 
     def serialize_state(self) -> dict:
         """Full-state snapshot as plain JSON docs (region snapshot
@@ -627,14 +634,19 @@ class SCDStoreImpl(_TxnTimeMixin, _CachedSearchMixin, SCDStore):
     def snapshot_refs(self) -> tuple:
         """Record references for a consistent cut (cheap; call under
         the store lock); serialize_refs may then run outside it."""
-        return (list(self._ops.values()), list(self._subs.values()))
+        return (
+            list(self._ops.values()),
+            list(self._subs.values()),
+            list(self._csts.values()),
+        )
 
     @staticmethod
     def serialize_refs(refs: tuple) -> dict:
-        ops, subs = refs
+        ops, subs, csts = refs
         return {
             "ops": [codec.op_to_doc(x) for x in ops],
             "subs": [codec.scd_sub_to_doc(x) for x in subs],
+            "constraints": [codec.constraint_to_doc(x) for x in csts],
         }
 
     def restore_state(self, state: dict) -> None:
@@ -647,6 +659,11 @@ class SCDStoreImpl(_TxnTimeMixin, _CachedSearchMixin, SCDStore):
             sub = codec.doc_to_scd_sub(d)
             self._subs[sub.id] = sub
             self._index_scd_sub(sub)
+        # absent on pre-constraint snapshots (rolling upgrade): .get
+        for d in state.get("constraints", []):
+            cst = codec.doc_to_constraint(d)
+            self._csts[cst.id] = cst
+            self._index_cst(cst)
 
 
     def _visible_op(self, id) -> Optional[scdm.Operation]:
@@ -661,6 +678,13 @@ class SCDStoreImpl(_TxnTimeMixin, _CachedSearchMixin, SCDStore):
         if sub is None or to_nanos(sub.end_time) < self._now_ns():
             return None
         return sub
+
+    def _visible_cst(self, id) -> Optional[scdm.Constraint]:
+        """Expired constraints are invisible, same rule as operations."""
+        cst = self._csts.get(id)
+        if cst is None or to_nanos(cst.end_time) < self._now_ns():
+            return None
+        return cst
 
     # -- Operations ----------------------------------------------------------
 
@@ -692,6 +716,17 @@ class SCDStoreImpl(_TxnTimeMixin, _CachedSearchMixin, SCDStore):
             self._owners.intern(sub.owner),
         )
 
+    def _index_cst(self, cst):
+        self._cst_index.put(
+            cst.id,
+            cst.cells,
+            cst.altitude_lower,
+            cst.altitude_upper,
+            to_nanos(cst.start_time),
+            to_nanos(cst.end_time),
+            self._owners.intern(cst.owner),
+        )
+
     def _op_t_end(self, i) -> Optional[int]:
         op = self._ops.get(i)
         return None if op is None else to_nanos(op.end_time)
@@ -699,6 +734,10 @@ class SCDStoreImpl(_TxnTimeMixin, _CachedSearchMixin, SCDStore):
     def _scd_sub_t_end(self, i) -> Optional[int]:
         sub = self._subs.get(i)
         return None if sub is None else to_nanos(sub.end_time)
+
+    def _cst_t_end(self, i) -> Optional[int]:
+        cst = self._csts.get(i)
+        return None if cst is None else to_nanos(cst.end_time)
 
     def _search_ops(
         self, cells, alt_lo, alt_hi, earliest, latest, *, allow_stale=False
@@ -750,19 +789,88 @@ class SCDStoreImpl(_TxnTimeMixin, _CachedSearchMixin, SCDStore):
             cells, alt_lo, alt_hi, earliest, latest, allow_stale=allow_stale
         )
 
-    def _notify_subs_locked(self, cells) -> List[scdm.Subscription]:
-        """Bump + return live subscriptions intersecting cells
-        (subscriptions.go:128-173)."""
-        ids = self._sub_index.query_ids(cells, now=self._now_ns())
+    def _search_csts(
+        self, cells, alt_lo, alt_hi, earliest, latest, *, allow_stale=False
+    ):
+        """ONE cached integration point for every constraint search
+        (public QUERY + the constraint-aware OVN precheck), the mirror
+        of _search_ops: fenced hits are bit-identical to the fresh
+        path, so serving write-safety checks from the cache is sound
+        for the fifth class exactly as for the other four."""
+        cells = canonical_cells(cells)
+        t0_ns = None if earliest is None else to_nanos(earliest)
+        t1_ns = None if latest is None else to_nanos(latest)
+        now = self._now_ns()
+        ids = self._cached_ids(
+            "constraint", self._cst_index, cells,
+            qkey=(
+                None if alt_lo is None else float(alt_lo),
+                None if alt_hi is None else float(alt_hi),
+                t0_ns, t1_ns,
+            ),
+            now_ns=now, allow_stale=allow_stale,
+            run=lambda: self._cst_index.query_ids(
+                cells,
+                alt_lo=alt_lo,
+                alt_hi=alt_hi,
+                t_start=t0_ns,
+                t_end=t1_ns,
+                now=now,
+                allow_stale=allow_stale,
+            ),
+            t_end_of=self._cst_t_end,
+        )
+        out = []
+        for i in sorted(ids):
+            cst = self._csts.get(i)
+            if cst is not None:
+                out.append(_copy_rec(cst))
+        return out
+
+    def search_constraints(
+        self, cells, alt_lo, alt_hi, earliest, latest, *, allow_stale=False
+    ):
+        if len(np.asarray(cells).ravel()) == 0:
+            raise errors.bad_request("missing cell IDs for query")
+        return self._search_csts(
+            cells, alt_lo, alt_hi, earliest, latest, allow_stale=allow_stale
+        )
+
+    def _notify_subs_locked(
+        self, cells, *, trigger: str = "operations",
+        alt_lo=None, alt_hi=None, t_start=None, t_end=None,
+    ) -> List[scdm.Subscription]:
+        """Bump + return live subscriptions intersecting cells whose
+        notification trigger matches the writing entity class
+        (subscriptions.go:128-173): operation writes bump
+        notify_for_operations subscriptions, constraint writes bump
+        notify_for_constraints ones.  Constraint callers additionally
+        pass the write's altitude/time window so only subscriptions
+        whose 4D volumes intersect the constraint fan out (an airport
+        closure must not wake a subscriber watching a different
+        altitude band)."""
+        ids = self._sub_index.query_ids(
+            cells, alt_lo=alt_lo, alt_hi=alt_hi,
+            t_start=None if t_start is None else to_nanos(t_start),
+            t_end=None if t_end is None else to_nanos(t_end),
+            now=self._now_ns(),
+        )
+        want_constraints = trigger == "constraints"
         out = []
         undo = []
         for i in sorted(ids):
+            prev = self._subs.get(i)
+            if prev is None:
+                continue
+            if want_constraints:
+                if not prev.notify_for_constraints:
+                    continue
+            elif not prev.notify_for_operations:
+                continue
             if self._capture_undo:
-                prev = self._subs.get(i)
-                if prev is not None:
-                    undo.append(
-                        {"t": "scd_sub_put", "doc": codec.scd_sub_to_doc(prev)}
-                    )
+                undo.append(
+                    {"t": "scd_sub_put", "doc": codec.scd_sub_to_doc(prev)}
+                )
             bumped = _bump_sub(self._subs, i)
             if bumped is not None:
                 out.append(dataclasses.replace(bumped))
@@ -803,6 +911,23 @@ class SCDStoreImpl(_TxnTimeMixin, _CachedSearchMixin, SCDStore):
             )
             key_set = set(key)
             missing = [c for c in conflicting if c.ovn not in key_set]
+            if op.constraint_aware:
+                # constraint-aware deconfliction: the op's USS consumes
+                # constraint updates, so its key must also cover every
+                # intersecting constraint's OVN — a stale view of an
+                # airspace closure is exactly the conflict the key
+                # check exists to catch
+                missing.extend(
+                    c
+                    for c in self._search_csts(
+                        op.cells,
+                        op.altitude_lower,
+                        op.altitude_upper,
+                        op.start_time,
+                        op.end_time,
+                    )
+                    if c.ovn not in key_set
+                )
             if missing:
                 raise errors.missing_ovns(missing)
         return old
@@ -879,6 +1004,86 @@ class SCDStoreImpl(_TxnTimeMixin, _CachedSearchMixin, SCDStore):
                         {"t": "scd_sub_put", "doc": codec.scd_sub_to_doc(sub)}
                     ]
                 self._journal(gc_rec)
+            return dataclasses.replace(old), subs
+
+    # -- Constraints ---------------------------------------------------------
+    #
+    # The fifth entity class, beyond the reference (which stubs it):
+    # same fencing/ownership discipline as operations, fan-out to
+    # notify_for_constraints subscriptions whose 4D volumes intersect
+    # the write, no OVN key check on the constraint itself.
+
+    def get_constraint(self, id):
+        cst = self._visible_cst(id)
+        if cst is None:
+            raise errors.not_found(id)
+        return dataclasses.replace(cst)
+
+    def upsert_constraint(self, cst):
+        with self._txn_scope():
+            old = self._visible_cst(cst.id)
+            if old is None and cst.version != 0:
+                raise errors.not_found(cst.id)
+            if old is not None and cst.version == 0:
+                raise errors.already_exists(cst.id)
+            if old is not None and cst.version != old.version:
+                raise errors.version_mismatch("old version")
+            if old is not None and old.owner != cst.owner:
+                raise errors.permission_denied(
+                    f"Constraint is owned by {old.owner}"
+                )
+            cst.validate_time_range()
+            ts = self._ts.commit_ts()
+            stored = dataclasses.replace(
+                cst,
+                version=(old.version if old else 0) + 1,
+                ovn=new_ovn_from_time(ts, cst.id),
+            )
+            if self._capture_undo:
+                # exact inverse: raw get includes an expired
+                # (invisible) record that `old` misses
+                prev_raw = self._csts.get(cst.id)
+                undo = [
+                    {"t": "scd_cst_put",
+                     "doc": codec.constraint_to_doc(prev_raw)}
+                    if prev_raw is not None
+                    else {"t": "scd_cst_del", "id": stored.id}
+                ]
+            self._csts[stored.id] = stored
+            self._index_cst(stored)
+            rec = {"t": "scd_cst_put", "doc": codec.constraint_to_doc(stored)}
+            if self._capture_undo:
+                rec["undo"] = undo
+            self._journal(rec)
+            subs = self._notify_subs_locked(
+                stored.cells, trigger="constraints",
+                alt_lo=stored.altitude_lower, alt_hi=stored.altitude_upper,
+                t_start=stored.start_time, t_end=stored.end_time,
+            )
+            return dataclasses.replace(stored), subs
+
+    def delete_constraint(self, id, owner):
+        with self._txn_scope():
+            old = self._visible_cst(id)
+            if old is None:
+                raise errors.not_found(id)
+            if old.owner != owner:
+                raise errors.permission_denied(
+                    f"Constraint is owned by {old.owner}"
+                )
+            subs = self._notify_subs_locked(
+                old.cells, trigger="constraints",
+                alt_lo=old.altitude_lower, alt_hi=old.altitude_upper,
+                t_start=old.start_time, t_end=old.end_time,
+            )
+            del self._csts[id]
+            self._cst_index.remove(id)
+            rec = {"t": "scd_cst_del", "id": id}
+            if self._capture_undo:
+                rec["undo"] = [
+                    {"t": "scd_cst_put", "doc": codec.constraint_to_doc(old)}
+                ]
+            self._journal(rec)
             return dataclasses.replace(old), subs
 
     # -- Subscriptions -------------------------------------------------------
@@ -1031,6 +1236,13 @@ class SCDStoreImpl(_TxnTimeMixin, _CachedSearchMixin, SCDStore):
         elif t == "scd_sub_bump":
             for i in rec["ids"]:
                 _bump_sub(self._subs, i)
+        elif t == "scd_cst_put":
+            cst = codec.doc_to_constraint(rec["doc"])
+            self._csts[cst.id] = cst
+            self._index_cst(cst)
+        elif t == "scd_cst_del":
+            self._csts.pop(rec["id"], None)
+            self._cst_index.remove(rec["id"])
 
 
 class DSSStore:
@@ -1103,11 +1315,11 @@ class DSSStore:
             # restored-backup rotation invalidates every cached answer
             epoch_fn = self._region_client.current_epoch
         # version-fenced read cache (dar/readcache.py): one shared
-        # instance fronting all four entity classes' search paths;
+        # instance fronting all five entity classes' search paths;
         # DSS_CACHE_* env knobs, configure_serving(cache=) at runtime
         self.cache = rcache.ReadCache(**rcache.env_knobs())
         # per-key-range query-load EWMA (dar/tiers.py RangeLoad): one
-        # shared map across all four classes — they cover one S2 key
+        # shared map across all five classes — they cover one S2 key
         # space and the sharded replica plans ONE boundary map from it.
         # Coalescer-served traffic stamps it below; attach_mesh_replica
         # hands the same instance to the replica so its own serving
@@ -1149,6 +1361,7 @@ class DSSStore:
             (self.rid._sub_index, "rid_sub"),
             (self.scd._op_index, "op"),
             (self.scd._sub_index, "scd_sub"),
+            (self.scd._cst_index, "constraint"),
         ):
             co = getattr(index, "coalescer", None)
             if co is not None:
@@ -1233,6 +1446,7 @@ class DSSStore:
         for index in (
             self.rid._isa_index, self.rid._sub_index,
             self.scd._op_index, self.scd._sub_index,
+            self.scd._cst_index,
         ):
             co = getattr(index, "coalescer", None)
             if co is not None:
@@ -1248,6 +1462,7 @@ class DSSStore:
         for index in (
             self.rid._isa_index, self.rid._sub_index,
             self.scd._op_index, self.scd._sub_index,
+            self.scd._cst_index,
         ):
             co = getattr(index, "coalescer", None)
             table = getattr(index, "table", None)
@@ -1272,6 +1487,7 @@ class DSSStore:
             (self.rid._sub_index, "rid_subs"),
             (self.scd._op_index, "ops"),
             (self.scd._sub_index, "scd_subs"),
+            (self.scd._cst_index, "constraints"),
         ]
         for index, cls in pairs:
             co = getattr(index, "coalescer", None)
@@ -1310,6 +1526,7 @@ class DSSStore:
         for index in (
             self.rid._isa_index, self.rid._sub_index,
             self.scd._op_index, self.scd._sub_index,
+            self.scd._cst_index,
         ):
             closer = getattr(index, "close", None)
             if closer is not None:
@@ -1324,6 +1541,7 @@ class DSSStore:
             ("rid_sub", self.rid.sub_index_stats),
             ("op", self.scd.index_stats),
             ("scd_sub", self.scd.sub_index_stats),
+            ("constraint", self.scd.cst_index_stats),
         ):
             for k, v in stats().items():
                 out[f"dss_dar_{name}_{k}"] = v
@@ -1364,6 +1582,7 @@ class DSSStore:
             ("rid_sub", self.rid._sub_index),
             ("op", self.scd._op_index),
             ("scd_sub", self.scd._sub_index),
+            ("constraint", self.scd._cst_index),
         ):
             clock = getattr(index, "cell_clock", None)
             classes[name] = {

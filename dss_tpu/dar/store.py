@@ -178,3 +178,36 @@ class SCDStore(abc.ABC):
         self, cells: np.ndarray, owner: str
     ) -> List[scdm.Subscription]:
         ...
+
+    # Constraints (beyond the reference: constraints_handler.go:12-30
+    # stubs these; here they are a first-class fifth entity class)
+    @abc.abstractmethod
+    def get_constraint(self, id: str) -> scdm.Constraint:
+        """By id, only while ends_at >= now (same visibility rule as
+        operations)."""
+
+    @abc.abstractmethod
+    def upsert_constraint(
+        self, cst: scdm.Constraint
+    ) -> Tuple[scdm.Constraint, List[scdm.Subscription]]:
+        """Fenced upsert (int32 version; 0 = insert).  Returns
+        (constraint, notify_for_constraints subscriptions whose 4D
+        volumes intersect the write, post-bump).  No OVN key check —
+        constraints deconflict operations, not each other."""
+
+    @abc.abstractmethod
+    def delete_constraint(
+        self, id: str, owner: str
+    ) -> Tuple[scdm.Constraint, List[scdm.Subscription]]:
+        ...
+
+    @abc.abstractmethod
+    def search_constraints(
+        self,
+        cells: np.ndarray,
+        alt_lo: Optional[float],
+        alt_hi: Optional[float],
+        earliest: Optional[datetime],
+        latest: Optional[datetime],
+    ) -> List[scdm.Constraint]:
+        ...

@@ -1,8 +1,12 @@
-"""SCD entity models: Operation references + Subscriptions.
+"""SCD entity models: Operation references + Subscriptions + Constraints.
 
 Mirrors /root/reference/pkg/scd/models/operations.go and
 subscriptions.go: int32 fencing versions, OVNs, operation states, and
-the subscription time-range rules (shared with RID).
+the subscription time-range rules (shared with RID).  Constraint
+references go BEYOND the reference (constraints_handler.go:12-30 stubs
+them): same int32 fencing version + OVN discipline as operations, no
+state machine (a constraint is authoritative airspace data, not a
+negotiated intent).
 """
 
 from __future__ import annotations
@@ -48,6 +52,14 @@ class Operation:
     state: str = OperationState.UNKNOWN
     cells: np.ndarray = field(default_factory=lambda: np.array([], np.uint64))
     subscription_id: str = ""
+    # The op's USS consumes constraint updates (its subscription has
+    # notify_for_constraints) and therefore participates in
+    # constraint-aware deconfliction: upserts in REQUIRES_KEY states
+    # must present the OVN of every intersecting constraint, and the
+    # AirspaceConflict payload lists missing constraints alongside
+    # missing operations.  Ops that never declared awareness keep the
+    # reference's op-only key check.
+    constraint_aware: bool = False
 
     def validate_time_range(self) -> None:
         """operations.go:78-94."""
@@ -58,6 +70,37 @@ class Operation:
         if self.end_time < self.start_time:
             raise errors.bad_request(
                 "Operation time_end must be after time_start"
+            )
+
+
+@dataclass
+class Constraint:
+    """Constraint reference: an authority-published airspace restriction
+    (mass-event closure, emergency corridor, geofence).  Carries the
+    same int32 fencing version + OVN pair as Operation; unlike
+    operations there is no state machine and upserts never require an
+    OVN key — constraints deconflict operations, nothing deconflicts a
+    constraint."""
+
+    id: str
+    owner: Owner
+    version: int = 0  # int32 fencing token, same rules as Operation
+    ovn: OVN = ""
+    start_time: Optional[datetime] = None
+    end_time: Optional[datetime] = None
+    altitude_lower: Optional[float] = None
+    altitude_upper: Optional[float] = None
+    uss_base_url: str = ""
+    cells: np.ndarray = field(default_factory=lambda: np.array([], np.uint64))
+
+    def validate_time_range(self) -> None:
+        if self.start_time is None:
+            raise errors.bad_request("Constraint must have a time_start")
+        if self.end_time is None:
+            raise errors.bad_request("Constraint must have a time_end")
+        if self.end_time < self.start_time:
+            raise errors.bad_request(
+                "Constraint time_end must be after time_start"
             )
 
 

@@ -85,7 +85,7 @@ def env_move_interval_s() -> float:
         )
 
 # entity classes the replica serves (replica class name -> WAL prefix)
-CLASSES = ("ops", "isas", "rid_subs", "scd_subs")
+CLASSES = ("ops", "isas", "rid_subs", "scd_subs", "constraints")
 
 
 class _ClsSnap(NamedTuple):
@@ -503,6 +503,10 @@ class ShardedReplica:
             for d in state.get("rid", {}).get("subs", []):
                 r = self._rec_from_entity(codec.doc_to_rid_sub(d))
                 fresh["rid_subs"][r.entity_id] = r
+            # absent on pre-constraint snapshots (rolling upgrade)
+            for d in state.get("scd", {}).get("constraints", []):
+                r = self._rec_from_op_doc(d)
+                fresh["constraints"][r.entity_id] = r
             self._records = fresh
             for c in CLASSES:
                 # wholesale replacement invalidates the tier split: the
@@ -536,6 +540,12 @@ class ShardedReplica:
             )
         elif t == "scd_sub_del":
             self._del("scd_subs", rec["id"])
+        elif t == "scd_cst_put":
+            # constraint docs share the op doc's spatial field shape
+            # (altitude_lower/upper, start/end, cells)
+            self._put("constraints", self._rec_from_op_doc(rec["doc"]))
+        elif t == "scd_cst_del":
+            self._del("constraints", rec["id"])
         # rid_sub_bump / scd_sub_bump only touch notification indexes,
         # which the spatial replica does not serve
         self._applied_records += 1

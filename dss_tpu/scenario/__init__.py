@@ -1,0 +1,15 @@
+"""City-scale scenario harness: deterministic, seeded, replayable
+workloads with real spatial structure, driven through the full HTTP
+stack by `bench.py --leg scenario` (docs/OPERATIONS.md `DSS_SCENARIO_*`
+knob table)."""
+
+from dss_tpu.scenario.generator import (  # noqa: F401
+    SCENARIOS,
+    Phase,
+    Request,
+    Scenario,
+    build_scenario,
+    env_knobs,
+    materialize_body,
+    stream_digest,
+)

@@ -1,10 +1,14 @@
-"""SCD service: operation references + subscriptions + constraint stubs.
+"""SCD service: operation references + subscriptions + constraints.
 
 Mirrors pkg/scd: PutOperationReference with multi-volume extent union,
 implicit subscriptions, OVN key checks with the AirspaceConflict
 response on missing OVNs (operations_handler.go:171-309), subscription
-lifecycle (subscriptions_handler.go), and the not-yet-implemented
-constraint handlers (constraints_handler.go:12-30).
+lifecycle (subscriptions_handler.go).  Constraint references go BEYOND
+the reference (constraints_handler.go:12-30 raises "not yet
+implemented" on all four endpoints): real CRUD/query with the same
+owner/int32-version/OVN discipline as operations, notification fan-out
+to notify_for_constraints subscriptions, and constraint-aware
+operation deconfliction (docs/DESIGN.md "Constraint references").
 """
 
 from __future__ import annotations
@@ -32,19 +36,80 @@ def _area_error(e: Exception):
     return errors.bad_request(f"bad area: {e}")
 
 
-def _missing_ovns_response(ops: List[scdm.Operation]) -> dict:
+def _missing_ovns_response(
+    ops: List[scdm.Operation], csts: List[scdm.Constraint] = (),
+) -> dict:
     """The AirspaceConflictResponse body (pkg/scd/errors/errors.go:22-53);
     OVNs of other owners' operations are included — that is the point of
-    the response (the caller needs them for its key)."""
+    the response (the caller needs them for its key).  Constraint-aware
+    upserts additionally list intersecting constraints the key missed —
+    and the message names what is actually missing, so a client acting
+    on it re-queries the right entity class."""
+    missing = [w for w, lst in (
+        ("operation", ops), ("constraint", csts),
+    ) if lst]
+    what = " or ".join(missing) if missing else "operation"
     return {
         "message": (
-            "at least one current operation is missing from the key; "
+            f"at least one current {what} is missing from the key; "
             "no changes have been made"
         ),
         "entity_conflicts": [
             {"operation_reference": ser.op_to_json(op)} for op in ops
+        ]
+        + [
+            {"constraint_reference": ser.constraint_to_json(c)}
+            for c in csts
         ],
     }
+
+
+def _extents_to_covering(params: dict):
+    """Union a PUT's multi-volume `extents` and compute the covering —
+    the shared ingress path of operation AND constraint upserts.
+    Returns (union Volume4D, cells); raises the same wire errors for
+    both entity classes so a fix to one cannot miss the other."""
+    extents_json = params.get("extents") or []
+    extents = [ser.volume4d_from_scd_json(e) for e in extents_json]
+    try:
+        u_extent = union_volumes_4d(extents)
+    except geo_covering.AreaTooLargeError as e:
+        raise errors.area_too_large(str(e))
+    except (geo_covering.BadAreaError, ValueError) as e:
+        raise errors.bad_request(f"failed to union extents: {e}")
+    if u_extent.start_time is None:
+        raise errors.bad_request("missing time_start from extents")
+    if u_extent.end_time is None:
+        raise errors.bad_request("missing time_end from extents")
+    try:
+        with stages.stage("covering_ms"):
+            cells = u_extent.calculate_spatial_covering()
+    except (
+        geo_covering.AreaTooLargeError,
+        geo_covering.BadAreaError,
+        ValueError,
+    ) as e:
+        raise _area_error(e)
+    return u_extent, cells
+
+
+def _aoi_to_covering(params: dict):
+    """Parse a query's `area_of_interest` and compute the covering —
+    the shared ingress path of every SCD search/query endpoint."""
+    aoi = params.get("area_of_interest")
+    if aoi is None:
+        raise errors.bad_request("missing area_of_interest")
+    vol4 = ser.volume4d_from_scd_json(aoi)
+    try:
+        with stages.stage("covering_ms"):
+            cells = vol4.calculate_spatial_covering()
+    except (
+        geo_covering.AreaTooLargeError,
+        geo_covering.BadAreaError,
+        ValueError,
+    ) as e:
+        raise _area_error(e)
+    return vol4, cells
 
 
 class SCDService:
@@ -60,27 +125,7 @@ class SCDService:
             raise errors.bad_request("missing Operation ID")
         if not params.get("uss_base_url"):
             raise errors.bad_request("missing required UssBaseUrl")
-        extents_json = params.get("extents") or []
-        extents = [ser.volume4d_from_scd_json(e) for e in extents_json]
-        try:
-            u_extent = union_volumes_4d(extents)
-        except geo_covering.AreaTooLargeError as e:
-            raise errors.area_too_large(str(e))
-        except (geo_covering.BadAreaError, ValueError) as e:
-            raise errors.bad_request(f"failed to union extents: {e}")
-        if u_extent.start_time is None:
-            raise errors.bad_request("missing time_start from extents")
-        if u_extent.end_time is None:
-            raise errors.bad_request("missing time_end from extents")
-        try:
-            with stages.stage("covering_ms"):
-                cells = u_extent.calculate_spatial_covering()
-        except (
-            geo_covering.AreaTooLargeError,
-            geo_covering.BadAreaError,
-            ValueError,
-        ) as e:
-            raise _area_error(e)
+        u_extent, cells = _extents_to_covering(params)
 
         subscription_id = params.get("subscription_id") or ""
         key = [str(k) for k in (params.get("key") or [])]
@@ -105,12 +150,19 @@ class SCDService:
                 validate_uss_base_url(new_sub.get("uss_base_url", ""))
             except ValueError as e:
                 raise errors.bad_request(str(e))
+            # constraint awareness rides the subscription the op rides:
+            # a USS that asked for constraint notifications consumes
+            # constraint updates and must key against them
+            op.constraint_aware = bool(
+                new_sub.get("notify_for_constraints", False)
+            )
 
         @contextlib.contextmanager
         def conflict_details():
             """On MISSING_OVNS, attach the AirspaceConflictResponse
             payload with the full conflict set
-            (operations_handler.go:268-280)."""
+            (operations_handler.go:268-280) — operations always,
+            intersecting constraints when the op is constraint-aware."""
             try:
                 yield
             except errors.StatusError as e:
@@ -122,10 +174,32 @@ class SCDService:
                         u_extent.start_time,
                         u_extent.end_time,
                     )
-                    e.details = _missing_ovns_response(ops)
+                    csts = (
+                        self.store.search_constraints(
+                            cells,
+                            u_extent.spatial_volume.altitude_lo,
+                            u_extent.spatial_volume.altitude_hi,
+                            u_extent.start_time,
+                            u_extent.end_time,
+                        )
+                        if op.constraint_aware
+                        else []
+                    )
+                    e.details = _missing_ovns_response(ops, csts)
                 raise
 
         with self.store.transaction():
+            if subscription_id:
+                # explicit subscription: awareness comes from ITS
+                # notify_for_constraints, resolved inside the txn so
+                # the precheck and the flag agree on one sub version.
+                # A missing/foreign subscription propagates (404): a
+                # typoed id must not silently downgrade the op to
+                # non-aware AND persist a dangling reference the USS
+                # thinks is delivering its notifications.
+                op.constraint_aware = self.store.get_subscription(
+                    subscription_id, owner
+                ).notify_for_constraints
             with conflict_details():
                 # Validate (incl. the OVN key check) BEFORE journaling
                 # the implicit subscription: a rejected conflict is a
@@ -183,19 +257,7 @@ class SCDService:
         }
 
     def search_operations(self, params: dict, owner: str) -> dict:
-        aoi = params.get("area_of_interest")
-        if aoi is None:
-            raise errors.bad_request("missing area_of_interest")
-        vol4 = ser.volume4d_from_scd_json(aoi)
-        try:
-            with stages.stage("covering_ms"):
-                cells = vol4.calculate_spatial_covering()
-        except (
-            geo_covering.AreaTooLargeError,
-            geo_covering.BadAreaError,
-            ValueError,
-        ) as e:
-            raise _area_error(e)
+        vol4, cells = _aoi_to_covering(params)
         sv = vol4.spatial_volume
         # allow_stale: public search may ride the mesh replica for
         # oversized batches (the conflict-response listing at :117 must
@@ -271,19 +333,7 @@ class SCDService:
         return {"subscription": ser.scd_sub_to_json(sub)}
 
     def query_subscriptions(self, params: dict, owner: str) -> dict:
-        aoi = params.get("area_of_interest")
-        if aoi is None:
-            raise errors.bad_request("missing area_of_interest")
-        vol4 = ser.volume4d_from_scd_json(aoi)
-        try:
-            with stages.stage("covering_ms"):
-                cells = vol4.calculate_spatial_covering()
-        except (
-            geo_covering.AreaTooLargeError,
-            geo_covering.BadAreaError,
-            ValueError,
-        ) as e:
-            raise _area_error(e)
+        _, cells = _aoi_to_covering(params)
         subs = self.store.search_subscriptions(cells, owner)
         return {"subscriptions": [ser.scd_sub_to_json(s) for s in subs]}
 
@@ -295,19 +345,70 @@ class SCDService:
             sub = self.store.delete_subscription(subscription_id, owner, 0)
         return {"subscription": ser.scd_sub_to_json(sub)}
 
-    # -- Constraints (stubbed, constraints_handler.go:12-30) -----------------
+    # -- Constraints (beyond the reference, which stubs these:
+    # constraints_handler.go:12-30) ------------------------------------------
 
-    def get_constraint(self, *_args, **_kw):
-        raise errors.bad_request("not yet implemented")
+    @errors.retry_write_conflicts
+    def put_constraint(self, entity_uuid: str, params: dict, owner: str) -> dict:
+        if not entity_uuid:
+            raise errors.bad_request("missing Constraint ID")
+        if not params.get("uss_base_url"):
+            raise errors.bad_request("missing required UssBaseUrl")
+        u_extent, cells = _extents_to_covering(params)
 
-    def put_constraint(self, *_args, **_kw):
-        raise errors.bad_request("not yet implemented")
+        cst = scdm.Constraint(
+            id=entity_uuid,
+            owner=owner,
+            version=ser.int_field(params.get("old_version"), "old_version"),
+            start_time=u_extent.start_time,
+            end_time=u_extent.end_time,
+            altitude_lower=u_extent.spatial_volume.altitude_lo,
+            altitude_upper=u_extent.spatial_volume.altitude_hi,
+            cells=cells,
+            uss_base_url=params["uss_base_url"],
+        )
+        with self.store.transaction():
+            stored, subs = self.store.upsert_constraint(cst)
+        return {
+            "constraint_reference": ser.constraint_to_json(stored),
+            "subscribers": ser.scd_subscribers_to_notify_json(subs),
+        }
 
-    def delete_constraint(self, *_args, **_kw):
-        raise errors.bad_request("not yet implemented")
+    def get_constraint(self, entity_uuid: str, owner: str) -> dict:
+        if not entity_uuid:
+            raise errors.bad_request("missing Constraint ID")
+        cst = self.store.get_constraint(entity_uuid)
+        if cst.owner != owner:
+            cst.ovn = ""  # OVNs are private to the owner
+        return {"constraint_reference": ser.constraint_to_json(cst)}
 
-    def query_constraints(self, *_args, **_kw):
-        raise errors.bad_request("not yet implemented")
+    @errors.retry_write_conflicts
+    def delete_constraint(self, entity_uuid: str, owner: str) -> dict:
+        if not entity_uuid:
+            raise errors.bad_request("missing Constraint ID")
+        with self.store.transaction():
+            cst, subs = self.store.delete_constraint(entity_uuid, owner)
+        return {
+            "constraint_reference": ser.constraint_to_json(cst),
+            "subscribers": ser.scd_subscribers_to_notify_json(subs),
+        }
+
+    def query_constraints(self, params: dict, owner: str) -> dict:
+        vol4, cells = _aoi_to_covering(params)
+        sv = vol4.spatial_volume
+        # allow_stale: public QUERY may ride the mesh replica; the
+        # constraint-aware precheck listing never sets it (it feeds
+        # the OVN key the client will retry with)
+        csts = self.store.search_constraints(
+            cells, sv.altitude_lo, sv.altitude_hi, vol4.start_time,
+            vol4.end_time, allow_stale=True,
+        )
+        out = []
+        for cst in csts:
+            if cst.owner != owner:
+                cst.ovn = ""
+            out.append(ser.constraint_to_json(cst))
+        return {"constraint_references": out}
 
     def make_dss_report(self, *_args, **_kw):
         raise errors.bad_request("not yet implemented")

@@ -285,6 +285,24 @@ def op_to_json(op: scdm.Operation) -> dict:
     return out
 
 
+def constraint_to_json(cst: scdm.Constraint) -> dict:
+    """scdpb.ConstraintReference wire shape — the same field set as an
+    operation reference minus state/subscription (a constraint is not a
+    negotiated intent)."""
+    out = {
+        "id": cst.id,
+        "ovn": cst.ovn,
+        "owner": cst.owner,
+        "version": cst.version,
+        "uss_base_url": cst.uss_base_url,
+    }
+    if cst.start_time is not None:
+        out["time_start"] = scd_time_json(cst.start_time)
+    if cst.end_time is not None:
+        out["time_end"] = scd_time_json(cst.end_time)
+    return out
+
+
 def scd_sub_to_json(sub: scdm.Subscription) -> dict:
     out = {
         "id": sub.id,

@@ -303,15 +303,66 @@ def test_scd_conflict_flow_409_airspace_conflict(client, keypair):
     assert {OP1, OP2} <= ids
 
 
-def test_scd_constraints_unimplemented(client, keypair):
-    # reference: BadRequest("not yet implemented") -> 400
-    # (constraints_handler.go:12-30)
-    h = hdr(keypair, scope="utm.constraint_management")
+CST1 = "dddddddd-dddd-4ddd-8ddd-ddddddddddd5"
+
+
+def test_scd_constraint_crud_over_http(client, keypair):
+    # the reference 400s "not yet implemented" here
+    # (constraints_handler.go:12-30); we serve real CRUD with the CM/CC
+    # scope split (PutConstraintReference needs constraint_management;
+    # consumption scopes may read/query)
+    cm = hdr(keypair, scope="utm.constraint_management", sub="authority")
+    cc = hdr(keypair, scope="utm.constraint_consumption", sub="uss1")
+
+    # a consumption-only token must NOT write constraints
     r = client.put(
-        f"/dss/v1/constraint_references/{OP1}", json={}, headers=h
+        f"/dss/v1/constraint_references/{CST1}",
+        json={
+            "extents": [scd_extent()],
+            "uss_base_url": "https://authority.example.com",
+        },
+        headers=cc,
     )
-    assert r.status_code == 400
-    assert "not yet implemented" in r.json()["message"]
+    assert r.status_code == 403
+
+    r = client.put(
+        f"/dss/v1/constraint_references/{CST1}",
+        json={
+            "extents": [scd_extent()],
+            "uss_base_url": "https://authority.example.com",
+        },
+        headers=cm,
+    )
+    assert r.status_code == 200, r.text
+    ref = r.json()["constraint_reference"]
+    assert ref["id"] == CST1 and ref["version"] == 1 and ref["ovn"]
+
+    # GET with a consumption scope: OVN blanked for the non-owner
+    r = client.get(f"/dss/v1/constraint_references/{CST1}", headers=cc)
+    assert r.status_code == 200
+    assert r.json()["constraint_reference"]["ovn"] == ""
+
+    # QUERY with a strategic-coordination scope
+    sc = hdr(keypair, scope=SCD_SCOPE_STR)
+    r = client.post(
+        "/dss/v1/constraint_references/query",
+        json={"area_of_interest": scd_extent()},
+        headers=sc,
+    )
+    assert r.status_code == 200
+    assert {c["id"] for c in r.json()["constraint_references"]} == {CST1}
+
+    # DELETE: wrong owner denied, owner succeeds
+    cm2 = hdr(keypair, scope="utm.constraint_management", sub="mallory")
+    r = client.delete(
+        f"/dss/v1/constraint_references/{CST1}", headers=cm2
+    )
+    assert r.status_code == 403
+    r = client.delete(
+        f"/dss/v1/constraint_references/{CST1}", headers=cm
+    )
+    assert r.status_code == 200
+    assert "subscribers" in r.json()
 
 
 def test_aux_validate_oauth(client, keypair):

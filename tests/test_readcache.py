@@ -505,7 +505,9 @@ def test_http_freshness_header_and_status():
         assert gen and int(gen[0][4:]) >= 0
         st = requests.get(f"{srv.base}/status", timeout=10).json()
         assert st["cache"]["hits"] >= 1
-        assert set(st["classes"]) == {"isa", "rid_sub", "op", "scd_sub"}
+        assert set(st["classes"]) == {
+                "isa", "rid_sub", "op", "scd_sub", "constraint",
+            }
         for c in st["classes"].values():
             assert {"generation", "cell_clock_high_water",
                     "live_records"} <= set(c)

@@ -1,0 +1,356 @@
+"""Constraint references (the fifth entity class, beyond the stubbed
+reference): OVN + int32 version fencing, owner scoping, constraint-aware
+operation deconfliction payloads, notification-index bumps on
+notify_for_constraints subscriptions, WAL durability, and the
+version-fenced read cache on the constraint query path."""
+
+from datetime import timedelta
+
+import pytest
+
+from dss_tpu import errors
+from dss_tpu.clock import FakeClock
+from dss_tpu.dar.dss_store import DSSStore
+from dss_tpu.services.scd import SCDService
+from dss_tpu.services.serialization import format_time
+from tests.test_store_contract import T0
+
+CST1 = "cccccccc-cccc-4ccc-8ccc-ccccccccccc1"
+CST2 = "cccccccc-cccc-4ccc-8ccc-ccccccccccc2"
+OP1 = "aaaaaaaa-aaaa-4aaa-8aaa-aaaaaaaaaaa1"
+SUB1 = "bbbbbbbb-bbbb-4bbb-8bbb-bbbbbbbbbbb1"
+SUB2 = "bbbbbbbb-bbbb-4bbb-8bbb-bbbbbbbbbbb2"
+
+
+def scd_extent(lat=40.0, lng=-100.0, half=0.02, alt=(0.0, 500.0),
+               t0=None, t1=None):
+    return {
+        "volume": {
+            "outline_polygon": {
+                "vertices": [
+                    {"lat": lat - half, "lng": lng - half},
+                    {"lat": lat - half, "lng": lng + half},
+                    {"lat": lat + half, "lng": lng + half},
+                    {"lat": lat + half, "lng": lng - half},
+                ]
+            },
+            "altitude_lower": {"value": alt[0], "reference": "W84", "units": "M"},
+            "altitude_upper": {"value": alt[1], "reference": "W84", "units": "M"},
+        },
+        "time_start": {"value": format_time(t0 or T0), "format": "RFC3339"},
+        "time_end": {
+            "value": format_time(t1 or (T0 + timedelta(hours=1))),
+            "format": "RFC3339",
+        },
+    }
+
+
+def cst_params(**kw):
+    p = {
+        "extents": [scd_extent()],
+        "uss_base_url": "https://authority.example.com",
+        "old_version": 0,
+    }
+    p.update(kw)
+    return p
+
+
+def op_params(**kw):
+    p = {
+        "extents": [scd_extent(alt=(50.0, 200.0))],
+        "uss_base_url": "https://uss1.example.com",
+        "new_subscription": {
+            "uss_base_url": "https://uss1.example.com",
+            "notify_for_constraints": True,
+        },
+        "state": "Accepted",
+        "old_version": 0,
+        "key": [],
+    }
+    p.update(kw)
+    return p
+
+
+@pytest.fixture(params=["memory", "tpu"])
+def svc(request):
+    clock = FakeClock(T0)
+    store = DSSStore(storage=request.param, clock=clock)
+    s = SCDService(store.scd, clock)
+    s.fake_clock = clock
+    s.dss_store = store
+    return s
+
+
+def test_constraint_lifecycle_and_version_fencing(svc):
+    out = svc.put_constraint(CST1, cst_params(), "authority")
+    ref = out["constraint_reference"]
+    assert ref["id"] == CST1 and ref["version"] == 1 and ref["ovn"]
+    assert ref["owner"] == "authority"
+
+    # create again -> already exists (version 0 is an insert)
+    with pytest.raises(errors.StatusError) as ei:
+        svc.put_constraint(CST1, cst_params(), "authority")
+    assert ei.value.code == errors.Code.ALREADY_EXISTS
+
+    # stale version -> aborted
+    with pytest.raises(errors.StatusError) as ei:
+        svc.put_constraint(CST1, cst_params(old_version=7), "authority")
+    assert ei.value.code == errors.Code.ABORTED
+
+    # fenced update bumps version AND rotates the OVN (OVNs are
+    # seconds-precision commit-time hashes — models.go:35-40 — so the
+    # clock must actually advance)
+    svc.fake_clock.advance(seconds=2)
+    out2 = svc.put_constraint(CST1, cst_params(old_version=1), "authority")
+    ref2 = out2["constraint_reference"]
+    assert ref2["version"] == 2
+    assert ref2["ovn"] and ref2["ovn"] != ref["ovn"]
+
+    # update by another owner -> denied
+    with pytest.raises(errors.StatusError) as ei:
+        svc.put_constraint(CST1, cst_params(old_version=2), "mallory")
+    assert ei.value.code == errors.Code.PERMISSION_DENIED
+
+    got = svc.delete_constraint(CST1, "authority")["constraint_reference"]
+    assert got["version"] == 2
+    with pytest.raises(errors.StatusError):
+        svc.get_constraint(CST1, "authority")
+
+
+def test_constraint_owner_scoping(svc):
+    ovn = svc.put_constraint(CST1, cst_params(), "authority")[
+        "constraint_reference"
+    ]["ovn"]
+    # GET: non-owner sees a blanked OVN
+    assert (
+        svc.get_constraint(CST1, "authority")["constraint_reference"]["ovn"]
+        == ovn
+    )
+    assert (
+        svc.get_constraint(CST1, "uss2")["constraint_reference"]["ovn"] == ""
+    )
+    # QUERY: same scoping
+    q = svc.query_constraints({"area_of_interest": scd_extent()}, "uss2")
+    assert [c["ovn"] for c in q["constraint_references"]] == [""]
+    q = svc.query_constraints(
+        {"area_of_interest": scd_extent()}, "authority"
+    )
+    assert [c["ovn"] for c in q["constraint_references"]] == [ovn]
+    # disjoint area finds nothing
+    q = svc.query_constraints(
+        {"area_of_interest": scd_extent(lat=-40.0, lng=100.0)}, "authority"
+    )
+    assert q["constraint_references"] == []
+    # delete by non-owner -> denied
+    with pytest.raises(errors.StatusError) as ei:
+        svc.delete_constraint(CST1, "uss2")
+    assert ei.value.code == errors.Code.PERMISSION_DENIED
+
+
+def test_constraint_aware_deconfliction_payload(svc):
+    cst = svc.put_constraint(CST1, cst_params(), "authority")[
+        "constraint_reference"
+    ]
+
+    # a constraint-aware op (its subscription consumes constraint
+    # updates) missing the constraint's OVN gets the AirspaceConflict
+    # payload with the constraint listed — OVN included, that is the
+    # point of the response
+    with pytest.raises(errors.StatusError) as ei:
+        svc.put_operation(OP1, op_params(), "uss1")
+    err = ei.value
+    assert err.code == errors.Code.MISSING_OVNS
+    csts = [
+        c["constraint_reference"]
+        for c in err.details["entity_conflicts"]
+        if "constraint_reference" in c
+    ]
+    assert [c["id"] for c in csts] == [CST1]
+    assert csts[0]["ovn"] == cst["ovn"]
+
+    # retry with the key -> success
+    out = svc.put_operation(OP1, op_params(key=[cst["ovn"]]), "uss1")
+    assert out["operation_reference"]["version"] == 1
+
+    # an op that never declared awareness is NOT gated on constraints
+    # (the reference's op-only key check) — only OP1 conflicts
+    p = op_params(
+        uss_base_url="https://uss2.example.com",
+        new_subscription={
+            "uss_base_url": "https://uss2.example.com",
+            "notify_for_constraints": False,
+        },
+    )
+    with pytest.raises(errors.StatusError) as ei:
+        svc.put_operation(
+            "aaaaaaaa-aaaa-4aaa-8aaa-aaaaaaaaaaa2", p, "uss2"
+        )
+    kinds = sorted(
+        k for c in ei.value.details["entity_conflicts"] for k in c
+    )
+    assert kinds == ["operation_reference"]
+
+
+def test_op_with_dangling_subscription_id_is_404(svc):
+    # an explicit subscription_id must resolve: a typo must surface,
+    # not silently downgrade the op to non-constraint-aware while
+    # persisting a dangling reference
+    with pytest.raises(errors.StatusError) as ei:
+        svc.put_operation(
+            OP1,
+            op_params(subscription_id=SUB2, new_subscription=None),
+            "uss1",
+        )
+    assert ei.value.code == errors.Code.NOT_FOUND
+    # another owner's subscription is equally invisible (owner-scoped)
+    svc.put_subscription(
+        SUB1,
+        {
+            "extents": scd_extent(),
+            "uss_base_url": "https://other.example.com",
+            "notify_for_operations": True,
+            "notify_for_constraints": True,
+            "old_version": 0,
+        },
+        "other",
+    )
+    with pytest.raises(errors.StatusError) as ei:
+        svc.put_operation(
+            OP1,
+            op_params(subscription_id=SUB1, new_subscription=None),
+            "uss1",
+        )
+    assert ei.value.code == errors.Code.NOT_FOUND
+
+
+def test_constraint_notification_bumps(svc):
+    # a subscription with ONLY notify_for_constraints is accepted and
+    # MUST be bumped by constraint writes (the pre-PR bug: accepted but
+    # never notified)
+    svc.put_subscription(
+        SUB1,
+        {
+            "extents": scd_extent(),
+            "uss_base_url": "https://watcher.example.com",
+            "notify_for_operations": False,
+            "notify_for_constraints": True,
+            "old_version": 0,
+        },
+        "watcher",
+    )
+    # ops-only subscription in the same area: must NOT be woken by
+    # constraint writes
+    svc.put_subscription(
+        SUB2,
+        {
+            "extents": scd_extent(),
+            "uss_base_url": "https://opsonly.example.com",
+            "notify_for_operations": True,
+            "notify_for_constraints": False,
+            "old_version": 0,
+        },
+        "opsonly",
+    )
+
+    out = svc.put_constraint(CST1, cst_params(), "authority")
+    urls = {s["uss_base_url"] for s in out["subscribers"]}
+    assert urls == {"https://watcher.example.com"}
+    states = out["subscribers"][0]["subscriptions"]
+    assert states == [
+        {"subscription_id": SUB1, "notification_index": 1}
+    ]
+
+    # the constraints-only sub is not woken by OPERATION writes
+    op_out = svc.put_operation(
+        OP1,
+        op_params(
+            new_subscription={
+                "uss_base_url": "https://uss1.example.com",
+                "notify_for_constraints": True,
+            },
+            key=[out["constraint_reference"]["ovn"]],
+        ),
+        "uss1",
+    )
+    op_urls = {s["uss_base_url"] for s in op_out["subscribers"]}
+    assert "https://watcher.example.com" not in op_urls
+    assert "https://opsonly.example.com" in op_urls
+
+    # DELETE also fans out, with the next index
+    out = svc.delete_constraint(CST1, "authority")
+    # the op's implicit sub (notify_for_constraints=True) now rides too
+    urls = {s["uss_base_url"] for s in out["subscribers"]}
+    assert "https://watcher.example.com" in urls
+    watcher = [
+        s for s in out["subscribers"]
+        if s["uss_base_url"] == "https://watcher.example.com"
+    ][0]
+    assert watcher["subscriptions"][0]["notification_index"] == 2
+
+
+def test_constraint_4d_fanout_scoping(svc):
+    # subscription watching a DIFFERENT altitude band must not be woken
+    svc.put_subscription(
+        SUB1,
+        {
+            "extents": scd_extent(alt=(1000.0, 2000.0)),
+            "uss_base_url": "https://high.example.com",
+            "notify_for_operations": False,
+            "notify_for_constraints": True,
+            "old_version": 0,
+        },
+        "high",
+    )
+    out = svc.put_constraint(
+        CST1, cst_params(extents=[scd_extent(alt=(0.0, 120.0))]),
+        "authority",
+    )
+    assert out["subscribers"] == []
+
+
+def test_constraint_wal_replay(tmp_path):
+    wal = str(tmp_path / "dss.wal")
+    clock = FakeClock(T0)
+    store = DSSStore(storage="memory", clock=clock, wal_path=wal)
+    svc = SCDService(store.scd, clock)
+    svc.put_constraint(CST1, cst_params(), "authority")
+    svc.put_constraint(CST2, cst_params(), "authority")
+    svc.put_constraint(CST1, cst_params(old_version=1), "authority")
+    svc.delete_constraint(CST2, "authority")
+    store.close()
+
+    store2 = DSSStore(storage="memory", clock=clock, wal_path=wal)
+    svc2 = SCDService(store2.scd, clock)
+    got = svc2.get_constraint(CST1, "authority")["constraint_reference"]
+    assert got["version"] == 2
+    with pytest.raises(errors.StatusError):
+        svc2.get_constraint(CST2, "authority")
+    q = svc2.query_constraints({"area_of_interest": scd_extent()}, "x")
+    assert [c["id"] for c in q["constraint_references"]] == [CST1]
+    store2.close()
+
+
+@pytest.mark.parametrize("backend", ["memory", "tpu"])
+def test_constraint_query_rides_the_read_cache(backend, monkeypatch):
+    monkeypatch.setenv("DSS_CACHE_ENABLE", "1")
+    clock = FakeClock(T0)
+    store = DSSStore(storage=backend, clock=clock)
+    svc = SCDService(store.scd, clock)
+    svc.put_constraint(CST1, cst_params(), "authority")
+    aoi = {"area_of_interest": scd_extent()}
+
+    def cls_hits():
+        return store.cache.class_stats("constraint")["co_cache_hits"]
+
+    r1 = svc.query_constraints(aoi, "x")
+    h0 = cls_hits()
+    r2 = svc.query_constraints(aoi, "x")
+    assert cls_hits() == h0 + 1, "repeat constraint poll must hit"
+    assert r2 == r1
+    # a constraint write fences the cached answer out
+    svc.put_constraint(CST2, cst_params(), "authority")
+    r3 = svc.query_constraints(aoi, "x")
+    assert sorted(c["id"] for c in r3["constraint_references"]) == [
+        CST1, CST2,
+    ]
+    store.close()

@@ -202,10 +202,8 @@ def test_scd_subscription_requires_notify_trigger(svc):
         svc.put_subscription(SUB1, params, "uss1")
 
 
-def test_constraints_stubbed(svc):
-    with pytest.raises(errors.StatusError, match="not yet implemented"):
-        svc.put_constraint()
-    with pytest.raises(errors.StatusError, match="not yet implemented"):
-        svc.query_constraints()
+def test_dss_report_still_stubbed(svc):
+    # constraints are real now (tests/test_scd_constraints.py); the
+    # report endpoint remains the reference's stub
     with pytest.raises(errors.StatusError, match="not yet implemented"):
         svc.make_dss_report()

@@ -3,7 +3,9 @@ identical under random operation sequences.
 
 The store-contract tests pin known scenarios; this pins a longer tail:
 random interleavings of ISA create/delete, RID search, SCD operation
-put (with per-backend OVN keys)/delete, SCD search, and owner-scoped
+put (with per-backend OVN keys, alternating constraint-aware)/delete,
+SCD search, constraint put/delete/query (the fifth entity class rides
+the same differential), and owner-scoped
 RID subscription search on FOUR backends — memory, tpu with aggressive
 TIERED snapshots (folds forced mid-sequence so queries constantly
 cross the L0/L1/overlay split), tpu with tiering DISABLED
@@ -85,11 +87,64 @@ def _norm_outcome(fn, *args):
         return ("err", e.http_status, int(e.code))
 
 
+def _cst_aoi_at(lat, lng, h):
+    """Constraint-query AoI for one grid square (also the recovery
+    sweep's request shape — ONE definition for the whole file)."""
+    return {
+        "area_of_interest": {
+            "volume": {
+                "outline_polygon": {
+                    "vertices": [
+                        {"lat": lat, "lng": lng},
+                        {"lat": lat + h, "lng": lng},
+                        {"lat": lat + h, "lng": lng + h},
+                        {"lat": lat, "lng": lng + h},
+                    ]
+                },
+            },
+        }
+    }
+
+
+def _cst_aoi(rng):
+    """A constraint-query AoI over the same quantized grid as
+    _search_area (repeat polls exercise the cache's fifth class)."""
+    lat = BASE_LAT + 0.05 * int(rng.integers(0, 6))
+    lng = BASE_LNG + 0.05 * int(rng.integers(0, 6))
+    return _cst_aoi_at(lat, lng, 0.045)
+
+
+def _cst_put_body(ext):
+    """Constraint PUT params from one _extents draw — shared by both
+    fuzz tests so they exercise one request shape."""
+    return {
+        "extents": [
+            {
+                "volume": {
+                    "outline_polygon": ext["spatial_volume"][
+                        "footprint"
+                    ],
+                },
+                "time_start": {
+                    "value": ext["time_start"],
+                    "format": "RFC3339",
+                },
+                "time_end": {
+                    "value": ext["time_end"],
+                    "format": "RFC3339",
+                },
+            }
+        ],
+        "uss_base_url": "https://authority.example",
+    }
+
+
 def _index_tables(store):
     out = []
     for index in (
         store.rid._isa_index, store.rid._sub_index,
         store.scd._op_index, store.scd._sub_index,
+        store.scd._cst_index,
     ):
         t = getattr(index, "table", None)
         if t is not None:
@@ -144,9 +199,14 @@ def test_backends_agree_under_random_ops(seed, monkeypatch):
     op_ovns: dict = {n: {} for n in stores}
 
     rid_sub_versions: dict = {n: {} for n in stores}
+    # constraints: int32 versions are deterministic (same across
+    # backends) but tracked per backend anyway, like everything else;
+    # OVNs derive from per-store commit timestamps
+    cst_versions: dict = {n: {} for n in stores}
+    cst_ovns: dict = {n: {} for n in stores}
 
     for step in range(90):
-        op = rng.integers(0, 10)
+        op = rng.integers(0, 13)
         sid = str(uuid.UUID(int=int(rng.integers(0, 40)), version=4))
         if op == 0:  # ISA create (fresh id, same for both backends)
             create_id = (
@@ -204,7 +264,14 @@ def test_backends_agree_under_random_ops(seed, monkeypatch):
                     }
                 ],
                 "uss_base_url": "https://u.example",
-                "new_subscription": {"uss_base_url": "https://u.example"},
+                # alternate constraint awareness: aware ops must key
+                # against intersecting constraints too, and their
+                # conflict payloads carry constraint_reference entries
+                # — both sides of the gate run through the differential
+                "new_subscription": {
+                    "uss_base_url": "https://u.example",
+                    "notify_for_constraints": step % 2 == 0,
+                },
                 "state": "Accepted",
                 "old_version": 0,
             }
@@ -212,7 +279,15 @@ def test_backends_agree_under_random_ops(seed, monkeypatch):
                 n: _norm_outcome(
                     scd[n].put_operation,
                     sid,
-                    dict(body, key=list(op_ovns[n].values())),
+                    dict(
+                        body,
+                        key=list(op_ovns[n].values())
+                        + (
+                            list(cst_ovns[n].values())
+                            if step % 2 == 0
+                            else []
+                        ),
+                    ),
                     "u1",
                 )
                 for n in stores
@@ -276,6 +351,30 @@ def test_backends_agree_under_random_ops(seed, monkeypatch):
                     body,
                     "u1",
                 )
+                for n in stores
+            }
+        elif op == 10:  # constraint put (create, fenced update, or
+            #             stale-version rejection — version tracked)
+            body = _cst_put_body(_extents(rng))  # ONE coherent draw
+            outs = {
+                n: _norm_outcome(
+                    scd[n].put_constraint,
+                    sid,
+                    dict(body, old_version=cst_versions[n].get(sid, 0)),
+                    "u1",
+                )
+                for n in stores
+            }
+        elif op == 11:  # constraint delete (maybe-missing)
+            outs = {
+                n: _norm_outcome(scd[n].delete_constraint, sid, "u1")
+                for n in stores
+            }
+        elif op == 12:  # constraint query (quantized area, cache-able)
+            aoi = _cst_aoi(rng)
+            owner = ("u1", "u2")[int(rng.integers(0, 2))]
+            outs = {
+                n: _norm_outcome(scd[n].query_constraints, aoi, owner)
                 for n in stores
             }
         else:  # SCD search
@@ -370,6 +469,45 @@ def test_backends_agree_under_random_ops(seed, monkeypatch):
         elif op == 7:
             for m in rid_sub_versions.values():
                 m.pop(sid, None)
+        elif op == 10:
+            # int32 versions must agree EXACTLY across backends (they
+            # are deterministic counters, unlike the commit-timestamp
+            # versions of RID); subscriber fanout sets must agree too
+            vers = {
+                n: r["constraint_reference"]["version"]
+                for n, r in res.items()
+            }
+            for n in others:
+                assert vers[n] == vers["memory"], (step, n, vers)
+            # fanout targets are implicit subscriptions whose ids are
+            # per-store uuid4s: compare the (url, count) shape of the
+            # fanout, not the ids themselves
+            subs = {
+                n: sorted(
+                    (x["uss_base_url"], len(x["subscriptions"]))
+                    for x in r["subscribers"]
+                )
+                for n, r in res.items()
+            }
+            for n in others:
+                assert subs[n] == subs["memory"], (step, n, subs)
+            for n, r in res.items():
+                cst_versions[n][sid] = r["constraint_reference"]["version"]
+                cst_ovns[n][sid] = r["constraint_reference"]["ovn"]
+        elif op == 11:
+            for m in cst_versions.values():
+                m.pop(sid, None)
+            for m in cst_ovns.values():
+                m.pop(sid, None)
+        elif op == 12:
+            ids = {
+                n: sorted(
+                    c["id"] for c in r["constraint_references"]
+                )
+                for n, r in res.items()
+            }
+            for n in others:
+                assert ids[n] == ids["memory"], (step, n, ids)
 
         if step % 6 == 5:
             # force folds mid-sequence so later queries cross the tier
@@ -434,7 +572,9 @@ def test_fuzz_with_fault_schedule(seed, monkeypatch):
     rng = np.random.default_rng(seed)
     isa_versions: dict = {n: {} for n in stores}
     op_ovns: dict = {n: {} for n in stores}
+    cst_versions: dict = {n: {} for n in stores}
     acked_isas: set = set()  # ids acked DURING the fault window
+    acked_csts: set = set()  # constraint ids acked DURING the window
 
     plan = chaos.FaultPlan.from_dict(
         {
@@ -466,7 +606,7 @@ def test_fuzz_with_fault_schedule(seed, monkeypatch):
                 chaos.clear_plan()
                 tpu.health.exit("device_lost")
             in_window = 12 <= step < 56
-            op = rng.integers(0, 6)
+            op = rng.integers(0, 8)
             sid = str(uuid.UUID(int=int(rng.integers(0, 24)), version=4))
             if op == 0:  # ISA create
                 create_id = (
@@ -541,6 +681,26 @@ def test_fuzz_with_fault_schedule(seed, monkeypatch):
                     )
                     for n in stores
                 }
+            elif op == 6:  # constraint put (fifth class through the
+                #            fault window: WAL + cache.populate seams)
+                body = _cst_put_body(_extents(rng))
+                outs = {
+                    n: _norm_outcome(
+                        scd[n].put_constraint, sid,
+                        dict(
+                            body,
+                            old_version=cst_versions[n].get(sid, 0),
+                        ),
+                        "u1",
+                    )
+                    for n in stores
+                }
+            elif op == 7:  # constraint query
+                aoi = _cst_aoi(rng)
+                outs = {
+                    n: _norm_outcome(scd[n].query_constraints, aoi, "u1")
+                    for n in stores
+                }
             else:  # SCD search
                 ext = _extents(rng)
                 aoi = {
@@ -601,6 +761,26 @@ def test_fuzz_with_fault_schedule(seed, monkeypatch):
             elif op == 4:
                 for n, r in res.items():
                     op_ovns[n][sid] = r["operation_reference"]["ovn"]
+            elif op == 6:
+                vers = {
+                    n: r["constraint_reference"]["version"]
+                    for n, r in res.items()
+                }
+                assert vers["tpu"] == vers["memory"], (step, vers)
+                for n, r in res.items():
+                    cst_versions[n][sid] = r["constraint_reference"][
+                        "version"
+                    ]
+                if in_window:
+                    acked_csts.add(sid)
+            elif op == 7:
+                ids = {
+                    n: sorted(
+                        c["id"] for c in r["constraint_references"]
+                    )
+                    for n, r in res.items()
+                }
+                assert ids["tpu"] == ids["memory"], (step, ids)
 
             if step % 8 == 7:
                 # folds/compactions mid-window: recovery state must be
@@ -625,6 +805,7 @@ def test_fuzz_with_fault_schedule(seed, monkeypatch):
         # across every quantized poll area; zero acked-write loss (the
         # writes acked during the window are still served)
         seen_tpu: set = set()
+        seen_cst_tpu: set = set()
         for i in range(6):
             for j in range(6):
                 for h in (0.02, 0.045):
@@ -645,12 +826,37 @@ def test_fuzz_with_fault_schedule(seed, monkeypatch):
                     )
                     assert am == bm, (area, am, bm)
                     seen_tpu.update(bm)
+                    # the fifth class sweeps the same grid: constraint
+                    # answers must also be bit-identical post-recovery
+                    aoi = _cst_aoi_at(lat, lng, h)
+                    ca = _norm_outcome(
+                        scd["memory"].query_constraints, aoi, "u1"
+                    )
+                    cb = _norm_outcome(
+                        scd["tpu"].query_constraints, aoi, "u1"
+                    )
+                    assert ca[0] == cb[0] == "ok", (area, ca, cb)
+                    cam = sorted(
+                        c["id"] for c in ca[1]["constraint_references"]
+                    )
+                    cbm = sorted(
+                        c["id"] for c in cb[1]["constraint_references"]
+                    )
+                    assert cam == cbm, (area, cam, cbm)
+                    seen_cst_tpu.update(cbm)
         still_live = {
             i for i in acked_isas if i in isa_versions["memory"]
         }
         assert still_live <= seen_tpu, (
             "acked-write loss after recovery",
             still_live - seen_tpu,
+        )
+        still_live_csts = {
+            i for i in acked_csts if i in cst_versions["memory"]
+        }
+        assert still_live_csts <= seen_cst_tpu, (
+            "acked constraint loss after recovery",
+            still_live_csts - seen_cst_tpu,
         )
     finally:
         chaos.clear_plan()
