@@ -1934,6 +1934,71 @@ def chaos_leg():
     return 0
 
 
+def federation_leg() -> int:
+    """`bench.py --leg federation`: the two-region partition drill
+    (cmds/federation_dryrun.py — seeded-FaultPlan leg + the SIGKILL
+    leg over four real processes), emitting a MULTICHIP-style
+    FED_r01.json with partition dwell, error-budget burn, and
+    recovery time.  Nonzero exit if any contract breaks: global-query
+    bit-identity vs the merged oracle, zero local 5xx through the
+    partition, stale reads marked and bounded, remote-owned writes
+    shed 503 with honest Retry-After, zero acked-write loss after
+    heal."""
+    import tempfile
+
+    from dss_tpu.cmds.federation_dryrun import run_dryrun
+
+    t0 = time.perf_counter()
+    with tempfile.TemporaryDirectory(prefix="dss-fedbench-") as td:
+        verdict = run_dryrun(td)
+    wall = round(time.perf_counter() - t0, 2)
+    sk = verdict.get("sigkill", {})
+    doc = {
+        "bench": "federation",
+        "format": 1,
+        "ok": bool(verdict.get("ok")),
+        "wall_s": wall,
+        "regions": 2,
+        "bit_identical": bool(sk.get("bit_identical")),
+        "partition_dwell_s": sk.get("partition_dwell_s"),
+        "recovery_s": sk.get("recovery_s"),
+        "error_budget": {
+            "requests": sk.get("requests_total"),
+            "unexpected_statuses": sk.get("unexpected_statuses"),
+            "burn": sk.get("error_budget_burn"),
+            "local_5xx_during_partition": sk.get(
+                "partition", {}
+            ).get("local_5xx"),
+        },
+        "faultplan": verdict.get("faultplan"),
+        "sigkill": sk,
+    }
+    out_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "FED_r01.json"
+    )
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=True, default=str)
+        f.write("\n")
+    print(
+        json.dumps(
+            {
+                "metric": "federation",
+                "value": 1 if doc["ok"] else 0,
+                "unit": "ok",
+                "detail": {
+                    "partition_dwell_s": doc["partition_dwell_s"],
+                    "recovery_s": doc["recovery_s"],
+                    "error_budget_burn": doc["error_budget"]["burn"],
+                    "bit_identical": doc["bit_identical"],
+                    "wall_s": wall,
+                    "artifact": os.path.basename(out_path),
+                },
+            }
+        )
+    )
+    return 0 if doc["ok"] else 1
+
+
 def _skew_reexec(leg: str):
     """The skew legs need the dp=1 x sp=8 virtual CPU mesh; when this
     process's jax backend has fewer devices (the north-star run on a
@@ -3432,7 +3497,7 @@ def main():
                  "resident-smoke", "poll", "cache-smoke", "skew",
                  "skew-smoke", "autotune", "autotune-smoke",
                  "chaos", "chaos-smoke", "scenario", "scenario-smoke",
-                 "http-curve"],
+                 "http-curve", "federation"],
         default="north-star",
         help="'north-star': the headline SCD conflict-qps benchmark "
         "(default); 'workers': multi-worker HTTP serving scaling smoke "
@@ -3472,7 +3537,11 @@ def main():
         "unexpected statuses, and a complete SLO report; 'http-curve': "
         "the BENCH_r06 mixed poll+write+bulk qps/latency sweep through "
         "the full HTTP stack with all six planner routes live "
-        "(DSS_BENCH_HTTP_QPS rates, out-of-process clients)",
+        "(DSS_BENCH_HTTP_QPS rates, out-of-process clients); "
+        "'federation': the two-region partition drill (seeded "
+        "FaultPlan leg + SIGKILL-a-region leg over real processes) "
+        "emitting FED_r01.json with partition dwell, error-budget "
+        "burn, and recovery time",
     )
     args = ap.parse_args()
     if args.leg == "workers":
@@ -3504,6 +3573,8 @@ def main():
         return scenario_leg(smoke=True)
     if args.leg == "http-curve":
         return http_curve_leg()
+    if args.leg == "federation":
+        return federation_leg()
 
     n_entities = int(os.environ.get("DSS_BENCH_ENTITIES", 1_000_000))
     n_cells = int(os.environ.get("DSS_BENCH_CELLS", 200_000))

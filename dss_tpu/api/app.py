@@ -59,6 +59,14 @@ RID_SCOPES = {
     _RID + "SearchSubscriptions": require_all_scopes(RID_READ),
     _AUX + "ValidateOauth": require_all_scopes(RID_WRITE),
     _AUX + "DebugProfile": require_all_scopes(RID_WRITE),
+    # cross-region federation peer surface: any read scope may query;
+    # sync ships full state, so it demands a read scope too
+    _AUX + "FederationQuery": require_any_scope(
+        RID_READ, SCD_SC, SCD_CC, SCD_CM
+    ),
+    _AUX + "FederationSync": require_any_scope(
+        RID_READ, SCD_SC, SCD_CC, SCD_CM
+    ),
 }
 
 SCD_SCOPES = {
@@ -185,6 +193,24 @@ def make_timeout_middleware(timeout_s: float):
     return timeout_middleware
 
 
+def _request_lag_bound(request) -> Optional[float]:
+    """The request's declared staleness bound (X-DSS-Max-Lag seconds)
+    for bounded-stale cross-region reads: the federation router
+    tightens its configured DSS_FED_STALE_LAG_S to this — a request
+    exceeding its own bound is rejected 503, never silently served
+    staler.  Unparseable values are ignored (the server bound
+    applies)."""
+    if request is None:
+        return None
+    raw = request.headers.get("X-DSS-Max-Lag")
+    if not raw:
+        return None
+    try:
+        return float(raw)
+    except ValueError:
+        return None
+
+
 async def _call(fn, *args, request=None):
     """Run a synchronous service call off the event loop.  The service
     layer holds the store lock and may run multi-ms TPU kernels (first
@@ -196,10 +222,12 @@ async def _call(fn, *args, request=None):
     from dss_tpu.dar import deadline as _deadline
     from dss_tpu.dar import readcache as _readcache
     from dss_tpu.obs import stages as _stages
+    from dss_tpu.region import federation as _fed
 
     loop = asyncio.get_running_loop()
     sink = None if request is None else request.get("dss_stages")
     route_dl = None if request is None else request.get("dss_deadline")
+    lag_bound = _request_lag_bound(request)
     t0 = time.perf_counter()
 
     def run():
@@ -207,6 +235,8 @@ async def _call(fn, *args, request=None):
             _stages.set_sink(sink)
         if route_dl is not None:
             _deadline.set_route_deadline(route_dl)
+        _fed.set_lag_bound(lag_bound)
+        _fed.take_fed_note()  # clear any stale note on this thread
         try:
             return fn(*args)
         finally:
@@ -218,6 +248,10 @@ async def _call(fn, *args, request=None):
             note = _readcache.take_note()
             if request is not None and note is not None:
                 request["dss_freshness"] = note
+            fed_note = _fed.take_fed_note()
+            if request is not None and fed_note is not None:
+                request["dss_fed"] = fed_note
+            _fed.set_lag_bound(None)
             if sink is not None:
                 _stages.set_sink(None)
             if route_dl is not None:
@@ -246,21 +280,46 @@ def _freshness_json_response(request, data) -> web.Response:
     `;mode=<condition>` — a degraded answer (hostchunk-only serving,
     fenced-cache reads during a region outage) is honest about it."""
     note = request.get("dss_freshness")
+    fed = request.get("dss_fed")
     headers = None
+    if note is None and fed is not None and fed["mode"] != "local":
+        # a purely-remote federated answer never touched the local
+        # read path: synthesize the base fields from the remote's
+        # freshness stamp so the header still carries epoch + gen
+        note = {
+            "epoch": fed["epoch"], "cls": fed["cls"] or "-",
+            "gen": fed["gen"], "hit": False,
+        }
     if note is not None:
         val = (
             f"epoch={note['epoch'] or '-'};"
             f"class={note['cls']};gen={note['gen']};"
             f"cache={'hit' if note['hit'] else 'miss'}"
         )
+        mode = None
         health_fn = request.app.get("dss_health_fn")
         if health_fn is not None:
             try:
                 mode = health_fn()
             except Exception:  # noqa: BLE001 — header is best-effort
                 mode = None
-            if mode and mode != "healthy":
-                val += f";mode={mode}"
+            if mode == "healthy":
+                mode = None
+        if mode is None and fed is not None and fed["mode"] == "stale":
+            # a declared-lag mirror answer is honest about it even
+            # when the ladder has already walked back
+            mode = "stale"
+        if mode:
+            val += f";mode={mode}"
+        if fed is not None:
+            # federation provenance: serving region(s), how the
+            # remote slice was served, and the worst measured lag
+            val += (
+                f";region={','.join(fed['regions'])}"
+                f";fed={fed['mode']}"
+            )
+            if fed["mode"] == "stale":
+                val += f";lag={fed['lag_s']:.3f}"
         headers = {"X-DSS-Freshness": val}
     return web.json_response(data, headers=headers)
 
@@ -270,6 +329,8 @@ def _freshness_json_response(request, data) -> web.Response:
 _GAUGE_VEC_LABELS = {
     "dss_breaker_state": "remote",
     "dss_fault_injected_total": "site",
+    "dss_fed_peer_state": "region",
+    "dss_fed_mirror_lag_s": "region",
 }
 
 
@@ -288,6 +349,10 @@ WORKER_LOCAL_ROUTES = {
     ("POST", "/dss/v1/operation_references/query"),
     ("POST", "/dss/v1/subscriptions/query"),
     ("POST", "/dss/v1/constraint_references/query"),
+    # NOTE: the federation peer surface is deliberately NOT here —
+    # worker-reader mode refuses --federation_map outright
+    # (cmds/server.py): a worker's plain WAL-tail replica would serve
+    # cross-region coverings partially.
 }
 
 _PROXY_SKIP_HEADERS = {
@@ -416,6 +481,7 @@ def build_app(
     health_fn=None,  # degradation mode: DSSStore.health.mode_name
     default_timeout_s: float = 10.0,
     replica=None,  # ShardedOpReplica: multi-chip read-replica surface
+    federation=None,  # FederationRouter: peer query/sync surface
     trace_requests: bool = False,
     profile_dir: str = "",
     worker_proxy=None,  # read-worker mode: proxy middleware to leader
@@ -466,6 +532,7 @@ def build_app(
         from dss_tpu.dar import deadline as _deadline
         from dss_tpu.dar import readcache as _readcache
         from dss_tpu.obs import stages as _stages
+        from dss_tpu.region import federation as _fed
 
         sink = request.get("dss_stages")
         before = None if sink is None else dict(sink)
@@ -476,10 +543,12 @@ def build_app(
         if route_dl is not None:
             _deadline.set_route_deadline(route_dl)
         _budget.set_host_only(True)
+        _fed.set_lag_bound(_request_lag_bound(request))
         # clear any stale freshness note on the loop thread: a prior
         # request that escalated to the executor mid-note must not
         # donate its note to this one (first-wins would keep it)
         _readcache.take_note()
+        _fed.take_fed_note()
         try:
             return fn(*args)
         except _budget.NeedsDevice:
@@ -493,12 +562,17 @@ def build_app(
             # requests may interleave during the await, and the
             # finally below must find this thread's slot empty
             _readcache.take_note()
+            _fed.take_fed_note()
             return await _call(fn, *args, request=request)
         finally:
             _budget.set_host_only(False)
             note = _readcache.take_note()
             if note is not None:
                 request["dss_freshness"] = note
+            fed_note = _fed.take_fed_note()
+            if fed_note is not None:
+                request["dss_fed"] = fed_note
+            _fed.set_lag_bound(None)
             if sink is not None:
                 _stages.set_sink(None)
                 sink["service_ms"] = round(
@@ -629,6 +703,36 @@ def build_app(
             )
 
         app.router.add_post("/debug/profile", debug_profile)
+
+    if federation is not None:
+        # the cross-region peer surface (region/federation.py): a
+        # remote region's router queries/syncs against the LOCAL
+        # stores (never recursing through the federation layer)
+        from dss_tpu.region import federation as _fedmod
+
+        async def federation_query(request):
+            auth(request, _AUX + "FederationQuery")
+            payload = await _params(request)
+            return web.json_response(
+                await _call_r(
+                    request,
+                    functools.partial(
+                        _fedmod.serve_query, federation, payload
+                    ),
+                )
+            )
+
+        async def federation_sync(request):
+            auth(request, _AUX + "FederationSync")
+            return web.json_response(
+                await _call_r(
+                    request,
+                    functools.partial(_fedmod.serve_sync, federation),
+                )
+            )
+
+        app.router.add_post("/aux/v1/federation/query", federation_query)
+        app.router.add_get("/aux/v1/federation/sync", federation_sync)
 
     if replica is not None:
         # the multi-chip read-replica surface (SURVEY §7 step 7): area

@@ -11,7 +11,8 @@ Three pieces (see each module's docstring):
            conflict cool-down)
   ladder   the store-level degradation state machine
            (HEALTHY -> DEVICE_LOST -> MESH_DEGRADED ->
-           REGION_LOG_DOWN) with re-warm-before-re-admit recovery
+           FEDERATION_DEGRADED -> REGION_LOG_DOWN) with
+           re-warm-before-re-admit recovery
 
 Import cost matters (dar/wal.py imports this): no jax, no numpy,
 stdlib only.
@@ -34,6 +35,7 @@ from dss_tpu.chaos.faults import (  # noqa: F401
 from dss_tpu.chaos.ladder import (  # noqa: F401
     CONDITIONS,
     DEVICE_LOST,
+    FEDERATION_DEGRADED,
     HEALTHY,
     MESH_DEGRADED,
     MODE_NAMES,

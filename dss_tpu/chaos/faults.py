@@ -51,6 +51,8 @@ Registered sites (grep for the literal to find the seam):
   resident.submit                 ops/resident.py (stream feeder)
   aot.compile                     ops/resident.py (AOT bucket build)
   cache.populate                  dar/dss_store.py (read-cache insert)
+  region.federation.request       region/federation.py (peer calls)
+  region.federation.sync          region/federation.py (mirror refresh)
 """
 
 from __future__ import annotations

@@ -6,7 +6,7 @@ mode, the region coordinator knew about dirty state.  The ladder makes
 the store's health ONE explicit state machine:
 
     HEALTHY (0) -> DEVICE_LOST (1) -> MESH_DEGRADED (2)
-                -> REGION_LOG_DOWN (3)
+                -> FEDERATION_DEGRADED (3) -> REGION_LOG_DOWN (4)
 
 driven by condition signals (enter/exit), where the MODE is the worst
 active condition.  Effects, wired in dar/dss_store.py + the planner:
@@ -23,6 +23,16 @@ active condition.  Effects, wired in dar/dss_store.py + the planner:
                     MultihostRuntime watchdog flags it); the mesh
                     route is already inadmissible via mesh_fresh —
                     the ladder makes the mode visible stack-wide.
+  FEDERATION_DEGRADED  a remote federated region is unreachable (its
+                    peer breaker opened — region/federation.py).
+                    Local-airspace serving is untouched; cross-region
+                    reads degrade to declared-lag stale answers from
+                    the local follower mirror (or 503 with the breaker
+                    cooldown as Retry-After once past the bound), and
+                    writes to remote-owned cells 503 honestly.
+                    Recovery re-syncs the follower tail BEFORE the
+                    condition clears, so remote routes re-admit with a
+                    warm mirror behind them.
   REGION_LOG_DOWN   the region log is unreachable (client breakers
                     open): writes answer 503 with an honest
                     Retry-After (breaker cooldown) while reads keep
@@ -47,6 +57,7 @@ __all__ = [
     "HEALTHY",
     "DEVICE_LOST",
     "MESH_DEGRADED",
+    "FEDERATION_DEGRADED",
     "REGION_LOG_DOWN",
     "CONDITIONS",
     "MODE_NAMES",
@@ -58,12 +69,14 @@ log = logging.getLogger("dss.chaos")
 HEALTHY = 0
 DEVICE_LOST = 1
 MESH_DEGRADED = 2
-REGION_LOG_DOWN = 3
+FEDERATION_DEGRADED = 3
+REGION_LOG_DOWN = 4
 
 # condition name -> ladder severity (mode = max of active conditions)
 CONDITIONS: Dict[str, int] = {
     "device_lost": DEVICE_LOST,
     "mesh_degraded": MESH_DEGRADED,
+    "federation_degraded": FEDERATION_DEGRADED,
     "region_log_down": REGION_LOG_DOWN,
 }
 
@@ -71,6 +84,7 @@ MODE_NAMES: Dict[int, str] = {
     HEALTHY: "healthy",
     DEVICE_LOST: "device_lost",
     MESH_DEGRADED: "mesh_degraded",
+    FEDERATION_DEGRADED: "federation_degraded",
     REGION_LOG_DOWN: "region_log_down",
 }
 

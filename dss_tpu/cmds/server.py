@@ -135,6 +135,23 @@ def make_parser() -> argparse.ArgumentParser:
         help="stable identity of this DSS instance within the region",
     )
     p.add_argument(
+        "--federation_map",
+        default=os.environ.get("DSS_FED_MAP", ""),
+        help="path to the format-versioned multi-region federation "
+        "map (S2-key-range -> region ownership + peer URLs, "
+        "region/federation.py).  Joins this region to the federation: "
+        "locality routing serves owned coverings locally, fans "
+        "cross-region slices out to peers, and serves bounded-stale "
+        "follower reads during partitions.  Env fallback DSS_FED_MAP; "
+        "DSS_FED_* knobs in docs/OPERATIONS.md",
+    )
+    p.add_argument(
+        "--federation_region",
+        default=os.environ.get("DSS_FED_REGION", ""),
+        help="this deployment's region id in the federation map "
+        "(overrides the map's 'local' field; env DSS_FED_REGION)",
+    )
+    p.add_argument(
         "--virtual_cpu_devices",
         type=int,
         default=0,
@@ -298,6 +315,11 @@ def build_worker(args) -> web.Application:
     log = get_logger("dss.worker")
     if not args.wal_path or not args.leader_url:
         raise SystemExit("--worker_reader needs --wal_path and --leader_url")
+    if args.federation_map:
+        raise SystemExit(
+            "--worker_reader cannot serve a federated region (see the"
+            " --federation_map/--workers refusal in the leader)"
+        )
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -444,6 +466,40 @@ def build(args) -> web.Application:
         store.cache.capacity,
         store.cache.stale_lag,
     )
+    fed_router = None
+    if args.federation_map and args.workers > 0:
+        # worker readers serve searches from a plain WAL-tail replica
+        # with no federation layer: a cross-region covering landing on
+        # a worker would return a silently PARTIAL answer, and peer
+        # federation calls would 404.  Refuse the combination until
+        # workers grow federation-aware routing (ROADMAP item 1's
+        # scale-out front is where that lands).
+        raise SystemExit(
+            "--federation_map with --workers > 0 is not supported yet:"
+            " read workers would serve cross-region coverings"
+            " partially; run federated instances single-process"
+        )
+    if args.federation_map:
+        # multi-region federation: attach BEFORE building services so
+        # they see the federated store wrappers (locality routing +
+        # ownership-guarded writes + bounded-stale remote reads)
+        from dss_tpu.region import federation as fedmod
+
+        fmap = fedmod.FederationMap.load(
+            args.federation_map, local=args.federation_region or None
+        )
+        fed_router = fedmod.FederationRouter.from_map(
+            fmap,
+            token=os.environ.get("DSS_FED_TOKEN") or None,
+            **fedmod.env_knobs(),
+        )
+        store.attach_federation(fed_router)
+        log.info(
+            "federation: region %s of %s (stale lag bound %.1fs, "
+            "sync every %.2fs)",
+            fmap.local, fmap.region_ids, fed_router.stale_lag_s,
+            fed_router.sync_interval_s,
+        )
     rid = RIDService(store.rid, clock)
     scd = SCDService(store.scd, clock) if args.enable_scd else None
 
@@ -627,6 +683,7 @@ def build(args) -> web.Application:
         health_fn=store.health.mode_name,
         default_timeout_s=args.default_timeout,
         replica=replica,
+        federation=fed_router,
         trace_requests=args.trace_requests,
         profile_dir=args.profile_dir,
         inline_reads=_inline_reads(args),

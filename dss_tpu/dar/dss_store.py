@@ -1370,6 +1370,11 @@ class DSSStore:
                 )
                 co.set_load_view(self.range_load)
                 co.set_health(self.health)
+        # multi-region federation (region/federation.py): None until
+        # attach_federation wraps the sub-stores with the locality
+        # router; stats() exports the stable dss_fed_* key set either
+        # way so dashboards never miss a series
+        self.federation = None
         self._replaying = False
         if region_url:
             self.region = RegionCoordinator(
@@ -1476,6 +1481,33 @@ class DSSStore:
                 n += warm(loop.kernel)
         return n
 
+    def attach_federation(self, router) -> None:
+        """Put the multi-region FederationRouter in front of the
+        store: binds the UNWRAPPED sub-stores for peer-facing serving
+        (a remote's query must never recurse through the federation
+        layer), wires the degradation ladder (remote-unreachable ->
+        FEDERATION_DEGRADED, recovery re-syncs the follower tail
+        before re-admission), swaps self.rid/self.scd for the
+        federated wrappers (searches federate, cells-carrying writes
+        are ownership-guarded), and starts the mirror sync loop.
+        Call BEFORE building services — they must see the wrappers."""
+        from dss_tpu.region import federation as fedmod
+
+        if self.federation is not None:
+            raise RuntimeError("federation already attached")
+        epoch_fn = None
+        if self.region is not None:
+            epoch_fn = self._region_client.current_epoch
+        router.bind_local(
+            self.rid, self.scd, epoch_fn=epoch_fn,
+            wall_clock=self.clock,
+        )
+        router.set_health(self.health)
+        self.federation = router
+        self.rid = fedmod.FederatedRIDStore(self.rid, router)
+        self.scd = fedmod.FederatedSCDStore(self.scd, router)
+        router.start()
+
     def attach_mesh_replica(self, replica, min_batch: int = 64) -> None:
         """Route oversized bounded-staleness search batches from each
         entity class's coalescer to the multi-chip replica when it is
@@ -1521,6 +1553,8 @@ class DSSStore:
             use_load(self.range_load)
 
     def close(self):
+        if self.federation is not None:
+            self.federation.close()
         if self.region is not None:
             self.region.close()
         for index in (
@@ -1567,6 +1601,15 @@ class DSSStore:
             if fn is not None:
                 breakers = fn()
         out["dss_breaker_state"] = breakers
+        # federation gauges: the stable key set whether or not a
+        # router is attached (dss_fed_peer_state/mirror_lag_s render
+        # as labeled families keyed by region)
+        from dss_tpu.region import federation as _fedmod
+
+        if self.federation is not None:
+            out.update(self.federation.stats())
+        else:
+            out.update(_fedmod.empty_stats())
         if self.region is not None:
             out.update(self.region.stats())
         return out
@@ -1604,4 +1647,10 @@ class DSSStore:
             # every active condition with its age and reason
             "degraded_mode": self.health.mode_name(),
             "degraded": self.health.active(),
+            # multi-region view: local region id, peer breaker states,
+            # mirror lags — the partition drill's observability seam
+            "federation": (
+                None if self.federation is None
+                else self.federation.status()
+            ),
         }

@@ -277,8 +277,137 @@ def measure_hit_concentration(ft, n_cells: int, *, batch: int = 256,
 # -- the sweep -----------------------------------------------------------------
 
 
+def scenario_shapes(*, seed: int = 7, scale: float = 0.05,
+                    duration_s: float = 8.0, names=None) -> dict:
+    """Derive the city-scale mixed-workload SHAPE SET from the
+    scenario generator (dss_tpu/scenario): per-tag request mix
+    (read/write split) and the covering-size distribution of the
+    query volumes the scenarios actually poll.  These are the shapes
+    the measured sweep below costs — so the emitted profile (and the
+    region-level capacity_weight the federation map planner consumes)
+    reflects city-scale traffic, not just the synthetic width-8
+    microbench queries."""
+    from dss_tpu.geo import covering as geo_covering
+    from dss_tpu.scenario import generator as scen
+
+    names = list(names or scen.SCENARIOS)
+
+    def polygon_cells(node) -> Optional[int]:
+        """Covering size of the first polygon found in a request
+        body (outline_polygon / footprint vertices)."""
+        if isinstance(node, dict):
+            verts = node.get("vertices")
+            if isinstance(verts, list) and len(verts) >= 3 and all(
+                isinstance(v, dict) and "lat" in v for v in verts
+            ):
+                area = ",".join(
+                    f"{v['lat']},{v['lng']}" for v in verts
+                )
+                try:
+                    return int(
+                        len(geo_covering.area_to_cell_ids(area))
+                    )
+                except Exception:  # noqa: BLE001 — oversized/degenerate
+                    return None
+            for v in node.values():
+                got = polygon_cells(v)
+                if got is not None:
+                    return got
+        elif isinstance(node, list):
+            for v in node:
+                got = polygon_cells(v)
+                if got is not None:
+                    return got
+        return None
+
+    mix: Dict[str, int] = {}
+    reads = writes = 0
+    widths: List[int] = []
+    for name in names:
+        sc = scen.build_scenario(name, seed=seed, scale=scale,
+                                 duration_s=duration_s)
+        for phase in sc.phases:
+            for r in phase.requests:
+                mix[r.tag] = mix.get(r.tag, 0) + 1
+                is_read = r.method == "GET" or r.path.endswith("/query")
+                if is_read:
+                    reads += 1
+                    n = None
+                    if r.body is not None:
+                        n = polygon_cells(r.body)
+                    elif "area=" in r.path:
+                        try:
+                            n = len(geo_covering.area_to_cell_ids(
+                                r.path.split("area=", 1)[1]
+                            ))
+                        except Exception:  # noqa: BLE001
+                            n = None
+                    if n:
+                        widths.append(n)
+                else:
+                    writes += 1
+    if not widths:
+        widths = [8]
+    w = np.sort(np.asarray(widths))
+    total = max(1, reads + writes)
+    return {
+        "scenarios": names,
+        "seed": seed,
+        "scale": scale,
+        "requests": int(total),
+        "read_frac": round(reads / total, 4),
+        "mix": dict(sorted(mix.items())),
+        "covering_cells": {
+            "p50": int(w[len(w) // 2]),
+            "p90": int(w[int(len(w) * 0.9)]),
+            "max": int(w[-1]),
+        },
+    }
+
+
+def measure_scenario_ms(ft, n_cells: int, shapes: dict, *,
+                        reps: int = 3, batch: int = 64) -> dict:
+    """Cost the scenario shape set on the MEASURED host kernel: forced
+    chunked exact scans at the scenario's covering-width percentiles
+    (p50 / p90 weighted 80/20 — the poll-heavy body and the heavy
+    tail), yielding a scenario-weighted per-request service time and
+    its qps scalar.  This is what capacity_weight is computed from
+    when the scenario sweep runs: a host's relative capacity under
+    city-scale traffic, measured, not assumed."""
+    cc = shapes["covering_cells"]
+    per_width: Dict[str, float] = {}
+    for label, width in (("p50", cc["p50"]), ("p90", cc["p90"])):
+        width = max(1, min(int(width), 512))
+        r = np.random.default_rng(17)
+        start = r.integers(0, max(1, n_cells - width), batch)
+        qkeys = (
+            start[:, None] + np.arange(width)[None, :]
+        ).astype(np.int32)
+        alo = r.uniform(0, 3000, batch).astype(np.float32)
+        t0 = NOW + r.integers(-2, 2, batch) * HOUR
+        args = (qkeys, alo, (alo + 300.0).astype(np.float32),
+                t0.astype(np.int64), (t0 + HOUR).astype(np.int64))
+        ft.query_host_chunked(*args, now=NOW)  # warm
+        ts = []
+        for i in range(reps):
+            t0c = time.perf_counter()
+            ft.query_host_chunked(
+                args[0], args[1], args[2], args[3] + i, args[4] + i,
+                now=NOW,
+            )
+            ts.append(time.perf_counter() - t0c)
+        per_width[label] = _median_ms(ts) / batch
+    weighted_ms = 0.8 * per_width["p50"] + 0.2 * per_width["p90"]
+    return {
+        "per_query_ms": {k: round(v, 5) for k, v in per_width.items()},
+        "weighted_ms": round(weighted_ms, 5),
+        "scenario_qps": round(1000.0 / max(weighted_ms, 1e-4), 2),
+    }
+
+
 def autotune(*, quick: bool = False, entities: Optional[int] = None,
-             cells: Optional[int] = None) -> dict:
+             cells: Optional[int] = None,
+             scenario: bool = True) -> dict:
     """Run the measured sweep on this host and return a profile dict.
 
     quick=True is the CI smoke grid: a tiny fixture, two stream
@@ -292,6 +421,7 @@ def autotune(*, quick: bool = False, entities: Optional[int] = None,
 
     t_all = time.perf_counter()
     table = _fixture(n_ent, n_cel)
+    scen_shapes = scen_ms = None
     try:
         ft = table._state.snap.fast
         chunk_ms = measure_chunk_ms(ft, n_cel, reps=reps)
@@ -301,6 +431,17 @@ def autotune(*, quick: bool = False, entities: Optional[int] = None,
             batch=128, window_bucket=256,
         )
         conc = measure_hit_concentration(ft, n_cel)
+        if scenario:
+            # city-scale load shapes from the scenario generator
+            # (ROADMAP PR 12 follow-on): the mixed-workload sweep that
+            # grounds capacity_weight in measured scenario traffic
+            scen_shapes = scenario_shapes(
+                scale=0.02 if quick else 0.05,
+                duration_s=4.0 if quick else 8.0,
+            )
+            scen_ms = measure_scenario_ms(
+                ft, n_cel, scen_shapes, reps=reps,
+            )
     finally:
         table.close()
 
@@ -328,29 +469,62 @@ def autotune(*, quick: bool = False, entities: Optional[int] = None,
         "DSS_RES_WINDOW_BUCKETS": window_buckets,
         "DSS_SHARD_RESULTS": conc["shard_results"],
     }
+    # this host's relative serving capacity: with the scenario sweep,
+    # the measured city-scale mixed-workload qps scalar (the same
+    # number the federation map planner weighs region key runs by);
+    # without it, the legacy synthetic chunk-qps scalar.  The basis is
+    # recorded so mixed fleets can tell profiles apart.
+    if scen_ms is not None:
+        capacity = scen_ms["scenario_qps"]
+        capacity_basis = "scenario-mix"
+    else:
+        capacity = round(64.0 / max(chunk_ms, 1e-3), 2)
+        capacity_basis = "chunk-qps"
+    measurements = {
+        "chunk_ms": round(chunk_ms, 4),
+        "device": dev,
+        "resident": res,
+        "hit_concentration": conc,
+    }
+    if scen_ms is not None:
+        measurements["scenario"] = dict(scen_ms, shapes=scen_shapes)
     return {
         "format": PROFILE_FORMAT,
         "host_class": host_class(),
         "quick": bool(quick),
         "fixture": {"entities": n_ent, "cells": n_cel},
         "sweep_s": round(time.perf_counter() - t_all, 2),
-        # this host's relative serving capacity (host-scan throughput
-        # in chunk-queries/ms): the per-member capacity vector for
-        # weighted_boundaries is assembled from member profiles
-        "capacity_weight": round(
-            64.0 / max(chunk_ms, 1e-3), 2
-        ),
+        "capacity_weight": capacity,
+        "capacity_basis": capacity_basis,
         "knobs": knobs,
-        "measurements": {
-            "chunk_ms": round(chunk_ms, 4),
-            "device": dev,
-            "resident": res,
-            "hit_concentration": conc,
-        },
+        "measurements": measurements,
     }
 
 
 # -- persistence / boot application --------------------------------------------
+
+
+def capacity_vector(profiles: List[dict]) -> np.ndarray:
+    """Assemble the member-capacity vector (weighted_boundaries
+    `member_capacity` / FederationMap region capacity_weights) from
+    per-host profiles, refusing MIXED capacity bases: a scenario-mix
+    qps scalar next to a legacy chunk-qps scalar differs by orders of
+    magnitude and would silently skew placement.  Re-run autotune on
+    the stragglers instead."""
+    if not profiles:
+        raise ValueError("no profiles")
+    bases = {
+        str(p.get("capacity_basis", "chunk-qps")) for p in profiles
+    }
+    if len(bases) > 1:
+        raise ValueError(
+            f"mixed capacity_basis across member profiles "
+            f"({sorted(bases)}): re-run autotune so every member "
+            f"measures the same basis"
+        )
+    return np.asarray(
+        [float(p["capacity_weight"]) for p in profiles], np.float64
+    )
 
 
 def save_profile(profile: dict, path: Optional[str] = None) -> str:

@@ -50,3 +50,8 @@ __all__ = [
     "SnapshotRequired",
     "build_region_app",
 ]
+
+# dss_tpu.region.federation (multi-region locality routing + bounded-
+# stale follower reads) is imported explicitly by its users — it pulls
+# in codec/models, which the lightweight client/consumers above don't
+# need at import time.

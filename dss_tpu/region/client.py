@@ -25,6 +25,7 @@ attempt is a half-open probe."""
 from __future__ import annotations
 
 import json
+import os
 import time
 import uuid
 from typing import List, Optional, Tuple
@@ -109,6 +110,16 @@ class RegionClient:
         # a mismatch raises EpochChanged until a resync site adopts
         self._epoch: Optional[str] = None
         self._seen_epoch: Optional[str] = None
+        # current_epoch() probe memo: when the epoch is still unknown
+        # (pre-bootstrap, or a client built only for fence consults),
+        # at most one cheap single-attempt probe per validity window —
+        # and none at all while the endpoint's breaker is open, so a
+        # read-cache fence consult during a region-log outage fails
+        # fast instead of stalling behind the transport retry ladder
+        self._epoch_probe_at = float("-inf")
+        self._epoch_probe_validity_s = float(
+            os.environ.get("DSS_REGION_EPOCH_VALID_S", 0.5)
+        )
 
     @property
     def base(self) -> str:
@@ -266,7 +277,45 @@ class RegionClient:
         """The epoch this client's local state is built against — the
         region component of the read cache's version fence: entries
         stamped under an older epoch (a promotion, a restored-backup
-        rotation) can never be served after the flip."""
+        rotation) can never be served after the flip.
+
+        Known epoch -> pure local read (the hot path: every cache
+        fence consult lands here).  Unknown epoch -> one memoized,
+        breaker-gated, single-attempt /status probe per
+        DSS_REGION_EPOCH_VALID_S window: entries stamped under the
+        placeholder "" epoch would all be invalidated the moment the
+        real epoch is adopted, so learning it early is worth ONE cheap
+        probe — but never a retry ladder, and never any network at all
+        while the breaker is open (a region outage must not stall the
+        read path that exists to keep serving through it)."""
+        if self._epoch is not None:
+            return self._epoch
+        now = time.monotonic()
+        if now - self._epoch_probe_at < self._epoch_probe_validity_s:
+            return ""
+        self._epoch_probe_at = now
+        url = self._urls[self._active]
+        breaker = self._breakers.get(url)
+        if not breaker.allow():
+            return ""  # fail fast: the open breaker IS the answer
+        try:
+            chaos.fault_point("region.client.request", detail=url)
+            r = self._session.request(
+                "GET", url + "/status",
+                timeout=min(self._timeout, 1.0),
+            )
+        except (requests.RequestException, chaos.FaultError):
+            breaker.record_failure()
+            return ""
+        if r.status_code >= 500:
+            breaker.record_failure()
+            return ""
+        breaker.record_success()
+        ep = self._json(r).get("epoch")
+        if ep is not None:
+            # first-seen adopts, exactly as _check_epoch would
+            self._seen_epoch = str(ep)
+            self._epoch = self._seen_epoch
         return self._epoch or ""
 
     @staticmethod

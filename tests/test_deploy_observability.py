@@ -400,6 +400,9 @@ def test_openapi_spec_covers_every_route():
         metrics=MetricsRegistry(),
         profile_dir="/tmp/profiles",
         replica=_FakeReplica(),
+        # any non-None router registers the federation peer surface
+        # (handlers consult it only at request time)
+        federation=object(),
     )
     app_ops = set()
     for route in app.router.routes():
@@ -609,3 +612,60 @@ def test_shard_gauges_render_as_labeled_family():
     assert 'dss_shard_load{shard="1"} 3.0' in text
     assert "# TYPE dss_shard_load gauge" in text
     assert "dss_shard_imbalance_factor 1.54" in text
+
+
+def test_grafana_and_rules_cover_federation():
+    """The multi-region federation must stay observable: dashboard
+    panels over dss_fed_peer_state{region} / dss_fed_mirror_lag_s /
+    dss_fed_partitioned and the federated query mix, plus the
+    DssFederationPartitioned page and the mirror-lag warning
+    registered in the alert rules."""
+    dash = json.load(
+        open(os.path.join(ROOT, "deploy/grafana/dss-dashboard.json"))
+    )
+    exprs = [
+        t["expr"]
+        for p in dash["panels"]
+        for t in p.get("targets", [])
+    ]
+    for needed in (
+        "dss_fed_peer_state",
+        "dss_fed_mirror_lag_s",
+        "dss_fed_partitioned",
+        "dss_fed_stale_served",
+        "dss_fed_shed",
+        "dss_fed_sync_failures",
+    ):
+        assert any(needed in e for e in exprs), needed
+    rules = yaml.safe_load(
+        open(os.path.join(ROOT, "deploy/prometheus/rules.yaml"))
+    )
+    alerts = {
+        r.get("alert"): r["expr"]
+        for g in rules["groups"]
+        for r in g["rules"]
+    }
+    assert "DssFederationPartitioned" in alerts
+    assert "dss_fed_partitioned" in alerts["DssFederationPartitioned"]
+    assert "DssFederationMirrorLagHigh" in alerts
+    assert (
+        "dss_fed_mirror_lag_s" in alerts["DssFederationMirrorLagHigh"]
+    )
+
+
+def test_federation_gauges_render_as_labeled_families():
+    """dss_fed_peer_state and dss_fed_mirror_lag_s are keyed gauge
+    families labeled by region, and the stable dss_fed_* key set is
+    exported even with no federation attached (dashboards never miss
+    the series)."""
+    from dss_tpu.api.app import _GAUGE_VEC_LABELS
+    from dss_tpu.obs.metrics import MetricsRegistry
+
+    assert _GAUGE_VEC_LABELS["dss_fed_peer_state"] == "region"
+    assert _GAUGE_VEC_LABELS["dss_fed_mirror_lag_s"] == "region"
+    reg = MetricsRegistry()
+    reg.set_gauge_vec("dss_fed_peer_state", "region", {"b": 2.0})
+    reg.set_gauge_vec("dss_fed_mirror_lag_s", "region", {"b": 1.5})
+    text = reg.render()
+    assert 'dss_fed_peer_state{region="b"} 2.0' in text
+    assert 'dss_fed_mirror_lag_s{region="b"} 1.5' in text

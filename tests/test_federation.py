@@ -1,0 +1,758 @@
+"""Multi-region federation (dss_tpu/region/federation.py): ownership
+map, locality routing, bounded-stale follower reads, the
+FEDERATION_DEGRADED ladder rung, the X-DSS-Freshness stale-read
+contract, and the memoized breaker-gated epoch probe.
+
+The two-region fixture wires two in-process DSSStores with direct
+function-call transports (the HTTP peer surface and the in-process
+path share serve_query/serve_sync, so these tests exercise the same
+serving code the dryrun's real sockets do)."""
+
+from __future__ import annotations
+
+import time
+import uuid
+from datetime import datetime, timedelta, timezone
+
+import numpy as np
+import pytest
+
+from dss_tpu import chaos, errors
+from dss_tpu.clock import Clock
+from dss_tpu.dar.dss_store import DSSStore
+from dss_tpu.geo import covering as geo_covering
+from dss_tpu.geo.s2cell import cell_to_dar_key, dar_key_to_cell
+from dss_tpu.models import rid as ridm
+from dss_tpu.models import scd as scdm
+from dss_tpu.region import federation as fed
+
+T0 = datetime.now(timezone.utc) + timedelta(minutes=5)
+T1 = T0 + timedelta(hours=24)
+
+BOUNDARY = 1000  # region "a" owns dar keys < 1000, "b" owns the rest
+
+
+def _uid(n: int) -> str:
+    return str(uuid.UUID(int=n + 1, version=4))
+
+
+def _isa(n: int, keys) -> ridm.IdentificationServiceArea:
+    return ridm.IdentificationServiceArea(
+        id=_uid(n), owner="uss1", url="https://uss1.example/flights",
+        cells=dar_key_to_cell(np.asarray(keys, np.int64)),
+        start_time=T0, end_time=T1,
+        altitude_lo=0.0, altitude_hi=3000.0,
+    )
+
+
+def _constraint(n: int, keys) -> scdm.Constraint:
+    return scdm.Constraint(
+        id=_uid(500 + n), owner="uss1",
+        uss_base_url="https://uss1.example/c",
+        cells=dar_key_to_cell(np.asarray(keys, np.int64)),
+        start_time=T0, end_time=T1,
+        altitude_lower=0.0, altitude_upper=3000.0,
+    )
+
+
+def _inproc_transport(router_fn):
+    """Direct-call peer transport: same serve_query/serve_sync the
+    HTTP endpoints run."""
+
+    def transport(method, path, payload):
+        if path.endswith("/query"):
+            return fed.serve_query(router_fn(), payload)
+        return fed.serve_sync(router_fn())
+
+    return transport
+
+
+def _dead_transport(*a):
+    raise fed.PeerError("injected partition")
+
+
+@pytest.fixture()
+def two_regions():
+    """Two federated in-process regions (a: keys < 1000, b: rest) plus
+    a merged single-region oracle store.  No background sync thread —
+    tests drive sync_peer explicitly for determinism."""
+    entries = [fed.RegionEntry("a"), fed.RegionEntry("b")]
+    routers = {}
+    fmap_a = fed.FederationMap(entries, np.array([BOUNDARY], np.int32), "a")
+    fmap_b = fed.FederationMap(entries, np.array([BOUNDARY], np.int32), "b")
+    sa = DSSStore(storage="memory", clock=Clock())
+    sb = DSSStore(storage="memory", clock=Clock())
+    oracle = DSSStore(storage="memory", clock=Clock())
+    ra = fed.FederationRouter(
+        fmap_a,
+        {"b": fed.FederationPeer(
+            "b", _inproc_transport(lambda: routers["b"]),
+            fail_threshold=3, reset_s=0.3,
+        )},
+        stale_lag_s=5.0,
+    )
+    rb = fed.FederationRouter(
+        fmap_b,
+        {"a": fed.FederationPeer(
+            "a", _inproc_transport(lambda: routers["a"]),
+            fail_threshold=3, reset_s=0.3,
+        )},
+        stale_lag_s=5.0,
+    )
+    routers["a"], routers["b"] = ra, rb
+    sa.attach_federation(ra)
+    sb.attach_federation(rb)
+    ra.close()
+    rb.close()  # no background sync in tests
+    try:
+        yield sa, sb, oracle, ra, rb
+    finally:
+        fed.take_fed_note()
+        fed.set_lag_bound(None)
+        chaos.clear_plan()
+        for s in (sa, sb, oracle):
+            s.close()
+
+
+def _populate(sa, sb, oracle, *, n_a=3, n_b=3):
+    """Disjoint-ownership fixture data: region a writes low-key ISAs,
+    b high-key ones, the oracle gets everything."""
+    for i in range(n_a):
+        isa = _isa(i, range(10 * i, 10 * i + 4))
+        assert sa.rid.insert_isa(isa) is not None
+        assert oracle.rid.insert_isa(_isa(i, range(10 * i, 10 * i + 4)))
+    for i in range(n_b):
+        keys = range(1100 + 10 * i, 1104 + 10 * i)
+        assert sb.rid.insert_isa(_isa(100 + i, keys)) is not None
+        assert oracle.rid.insert_isa(_isa(100 + i, keys))
+
+
+GLOBAL_AREA = dar_key_to_cell(np.arange(0, 1300, dtype=np.int64))
+LOCAL_A_AREA = dar_key_to_cell(np.arange(0, 50, dtype=np.int64))
+
+
+# -- FederationMap -----------------------------------------------------------
+
+
+def test_map_split_and_ownership():
+    entries = [fed.RegionEntry("a"), fed.RegionEntry("b"),
+               fed.RegionEntry("c")]
+    m = fed.FederationMap(
+        entries, np.array([100, 200], np.int32), "b"
+    )
+    cells = dar_key_to_cell(np.array([5, 99, 100, 150, 250], np.int64))
+    parts = m.split_cells(cells)
+    assert sorted(parts) == ["a", "b", "c"]
+    assert list(cell_to_dar_key(parts["a"])) == [5, 99]
+    assert list(cell_to_dar_key(parts["b"])) == [100, 150]
+    assert list(cell_to_dar_key(parts["c"])) == [250]
+    assert m.remote_ids() == ["a", "c"]
+
+
+def test_map_validation():
+    e = [fed.RegionEntry("a"), fed.RegionEntry("b")]
+    with pytest.raises(ValueError, match="boundaries"):
+        fed.FederationMap(e, np.array([], np.int32), "a")
+    with pytest.raises(ValueError, match="not in map"):
+        fed.FederationMap(e, np.array([10], np.int32), "zz")
+    with pytest.raises(ValueError, match="duplicate"):
+        fed.FederationMap(
+            [fed.RegionEntry("a"), fed.RegionEntry("a")],
+            np.array([10], np.int32), "a",
+        )
+
+
+def test_map_round_trip_and_format(tmp_path):
+    e = [fed.RegionEntry("a", urls=("http://a:1",), capacity_weight=2.0),
+         fed.RegionEntry("b", urls=("http://b:1", "http://b:2"))]
+    m = fed.FederationMap(e, np.array([42], np.int32), "a")
+    p = str(tmp_path / "fmap.json")
+    m.save(p)
+    m2 = fed.FederationMap.load(p)
+    assert m2.to_doc() == m.to_doc()
+    assert m2.entry("a").capacity_weight == 2.0
+    # local override at load (one artifact, per-region deployments)
+    m3 = fed.FederationMap.load(p, local="b")
+    assert m3.local == "b"
+    # format versioning: refuse maps from the future
+    doc = m.to_doc()
+    doc["format"] = fed.MAP_FORMAT + 1
+    with pytest.raises(ValueError, match="format"):
+        fed.FederationMap.from_doc(doc)
+
+
+def test_map_plan_rides_weighted_boundaries_capacity():
+    """Region-level planning uses the SAME splitter as shard
+    placement: a region with double capacity_weight owns a
+    proportionally heavier key run."""
+    post_key = np.repeat(np.arange(0, 100, dtype=np.int32), 4)
+    uniform = fed.FederationMap.plan(
+        [fed.RegionEntry("a"), fed.RegionEntry("b")], post_key,
+        local="a",
+    )
+    skewed = fed.FederationMap.plan(
+        [fed.RegionEntry("a", capacity_weight=3.0),
+         fed.RegionEntry("b", capacity_weight=1.0)], post_key,
+        local="a",
+    )
+    assert len(uniform.boundaries) == len(skewed.boundaries) == 1
+    # 3x capacity -> region a's run extends well past the even split
+    assert int(skewed.boundaries[0]) > int(uniform.boundaries[0])
+
+
+# -- pure federation read plan (plan/planner.py) -----------------------------
+
+
+def test_decide_federation_read_table():
+    from dss_tpu.plan.planner import decide_federation_read as d
+
+    assert d(peer_allowed=True, cooldown_s=0, mirror_synced=False,
+             mirror_lag_s=9e9, lag_bound_s=1).route == "remote"
+    # breaker open + fresh mirror -> declared-lag stale
+    p = d(peer_allowed=False, cooldown_s=1.2, mirror_synced=True,
+          mirror_lag_s=0.5, lag_bound_s=5.0)
+    assert p.route == "stale"
+    # mirror past the bound -> shed with the cooldown as Retry-After
+    p = d(peer_allowed=False, cooldown_s=1.2, mirror_synced=True,
+          mirror_lag_s=9.0, lag_bound_s=5.0)
+    assert p.route == "shed" and p.retry_after_s == pytest.approx(1.2)
+    # never-synced mirror can't serve anything
+    assert d(peer_allowed=False, cooldown_s=0.0, mirror_synced=False,
+             mirror_lag_s=0.0, lag_bound_s=5.0).route == "shed"
+    # strict (non-stale-ok) queries never take the mirror
+    assert d(peer_allowed=False, cooldown_s=0.0, mirror_synced=True,
+             mirror_lag_s=0.1, lag_bound_s=5.0,
+             allow_stale=False).route == "shed"
+    # shed Retry-After is floored (no busy-polling a flapping link)
+    assert d(peer_allowed=False, cooldown_s=0.0, mirror_synced=False,
+             mirror_lag_s=0.0, lag_bound_s=5.0).retry_after_s >= 0.5
+
+
+# -- routing + merge bit-identity --------------------------------------------
+
+
+def test_global_query_bit_identical_to_merged_oracle(two_regions):
+    """The merged oracle is ONE store restored from both regions'
+    serialized state; a global federated query must be bit-identical
+    to it — full docs, commit-stamp versions included."""
+    import json as _json
+
+    from dss_tpu.dar import codec
+
+    sa, sb, oracle, ra, rb = two_regions
+    _populate(sa, sb, oracle)
+    merged = {
+        "isas": (sa.rid.serialize_state()["isas"]
+                 + sb.rid.serialize_state()["isas"]),
+        "subs": [],
+    }
+    oracle.rid.restore_state(merged)
+
+    def docs(recs):
+        return sorted(
+            _json.dumps(codec.isa_to_doc(i), sort_keys=True)
+            for i in recs
+        )
+
+    want = docs(oracle.rid.search_isas(GLOBAL_AREA, T0, None))
+    assert len(want) == 6
+    for s in (sa, sb):
+        got = docs(
+            s.rid.search_isas(GLOBAL_AREA, T0, None, allow_stale=True)
+        )
+        assert got == want
+    # single-region covering short-circuits (no remote call)
+    before = ra.peers["b"].requests
+    local = sa.rid.search_isas(LOCAL_A_AREA, T0, None, allow_stale=True)
+    assert len(local) == 3
+    assert ra.peers["b"].requests == before
+
+
+def test_scd_federation_and_constraints(two_regions):
+    sa, sb, oracle, ra, rb = two_regions
+    for i in range(2):
+        cst_a = _constraint(i, range(20 * i, 20 * i + 3))
+        assert sa.scd.upsert_constraint(cst_a)
+        assert oracle.scd.upsert_constraint(
+            _constraint(i, range(20 * i, 20 * i + 3))
+        )
+        cst_b = _constraint(50 + i, range(1150 + 20 * i, 1153 + 20 * i))
+        assert sb.scd.upsert_constraint(cst_b)
+        assert oracle.scd.upsert_constraint(
+            _constraint(50 + i, range(1150 + 20 * i, 1153 + 20 * i))
+        )
+    want = sorted(
+        c.id for c in oracle.scd.search_constraints(
+            GLOBAL_AREA, None, None, T0, T1
+        )
+    )
+    got = sorted(
+        c.id for c in sa.scd.search_constraints(
+            GLOBAL_AREA, None, None, T0, T1, allow_stale=True
+        )
+    )
+    assert got == want and len(got) == 4
+
+
+def test_remote_write_guard(two_regions):
+    sa, sb, oracle, ra, rb = two_regions
+    # healthy: wrong-region write is a 400 with the owner hint
+    with pytest.raises(errors.StatusError) as ei:
+        sa.rid.insert_isa(_isa(700, range(1100, 1104)))
+    assert ei.value.http_status == 400
+    assert "region" in ei.value.message
+    # spanning covering: also rejected (single-region serializability)
+    with pytest.raises(errors.StatusError):
+        sa.scd.upsert_constraint(_constraint(701, [10, 1100]))
+    # partitioned owner: honest 503 + Retry-After
+    ra.peers["b"].transport = _dead_transport
+    for _ in range(3):
+        ra.sync_peer("b")  # open the breaker
+    assert not ra.peers["b"].breaker.allow()
+    with pytest.raises(fed.FederationUnavailable) as ei:
+        sa.rid.insert_isa(_isa(702, range(1100, 1104)))
+    assert ei.value.http_status == 503
+    assert ei.value.retry_after_s >= 0.5
+    # local-airspace writes keep landing through it all
+    assert sa.rid.insert_isa(_isa(703, range(40, 44))) is not None
+
+
+def test_partition_stale_ladder_and_recovery(two_regions):
+    sa, sb, oracle, ra, rb = two_regions
+    _populate(sa, sb, oracle)
+    assert ra.sync_peer("b")  # mirror warm pre-partition
+    pre = sorted(
+        i.id for i in sa.rid.search_isas(
+            GLOBAL_AREA, T0, None, allow_stale=True
+        )
+    )
+    ra.peers["b"].transport = _dead_transport
+    for _ in range(3):
+        ra.sync_peer("b")
+    assert not ra.peers["b"].breaker.allow()
+    assert sa.health.is_active("federation_degraded")
+    assert sa.freshness_status()["degraded_mode"] == "federation_degraded"
+    # cross-region reads serve declared-lag stale from the mirror,
+    # bit-identical to the pre-partition answer
+    during = sorted(
+        i.id for i in sa.rid.search_isas(
+            GLOBAL_AREA, T0, None, allow_stale=True
+        )
+    )
+    assert during == pre
+    assert ra.stale_served >= 1
+    note = fed.take_fed_note()
+    assert note["mode"] == "stale" and "b" in note["regions"]
+    # local airspace never sees a 5xx
+    assert len(
+        sa.rid.search_isas(LOCAL_A_AREA, T0, None, allow_stale=True)
+    ) == 3
+    # a request whose declared bound the mirror exceeds is REJECTED,
+    # not silently served staler
+    fed.set_lag_bound(0.0)
+    with pytest.raises(fed.FederationUnavailable) as ei:
+        sa.rid.search_isas(GLOBAL_AREA, T0, None, allow_stale=True)
+    fed.set_lag_bound(None)
+    assert ei.value.retry_after_s >= 0.5
+    # strict (allow_stale=False) cross-region searches shed too
+    with pytest.raises(fed.FederationUnavailable):
+        sa.rid.search_subscriptions(GLOBAL_AREA)
+    # b keeps writing its own airspace during the partition
+    assert sb.rid.insert_isa(_isa(130, range(1250, 1254))) is not None
+    assert oracle.rid.insert_isa(_isa(130, range(1250, 1254)))
+    # HEAL: wait out the breaker cooldown, next sync succeeds, the
+    # ladder walks back, and the new write is visible cross-region
+    ra.peers["b"].transport = _inproc_transport(lambda: rb)
+    deadline = time.monotonic() + 5.0
+    while not ra.sync_peer("b"):
+        assert time.monotonic() < deadline
+        time.sleep(0.05)
+    assert not sa.health.is_active("federation_degraded")
+    assert sa.health.mode_name() == "healthy"
+    want = sorted(
+        i.id for i in oracle.rid.search_isas(GLOBAL_AREA, T0, None)
+    )
+    got = sorted(
+        i.id for i in sa.rid.search_isas(
+            GLOBAL_AREA, T0, None, allow_stale=True
+        )
+    )
+    assert got == want and _uid(130) in got
+
+
+def test_fault_sites_drive_partition(two_regions):
+    """The region.federation.request/sync fault sites inject a
+    deterministic cross-region partition (the chaos drill seam)."""
+    sa, sb, oracle, ra, rb = two_regions
+    _populate(sa, sb, oracle, n_a=1, n_b=1)
+    assert ra.sync_peer("b")
+    chaos.registry().reset_counters()
+    chaos.install_plan({
+        "seed": 11,
+        "events": [
+            {"site": "region.federation.sync", "action": "partition",
+             "count": -1},
+        ],
+    })
+    try:
+        for _ in range(3):
+            assert not ra.sync_peer("b")
+        assert sa.health.is_active("federation_degraded")
+        inj = chaos.registry().injected_by_site()
+        assert inj.get("region.federation.sync", 0) >= 3
+    finally:
+        chaos.clear_plan()
+    while not ra.sync_peer("b"):
+        time.sleep(0.05)
+    assert sa.health.mode_name() == "healthy"
+
+
+def test_mirror_search_matches_store(two_regions):
+    """The mirror's linear 4D filter answers exactly what the remote
+    store would for the mirrored state (same COALESCE semantics)."""
+    sa, sb, oracle, ra, rb = two_regions
+    _populate(sa, sb, oracle)
+    assert ra.sync_peer("b")
+    m = ra.mirrors["b"]
+    for area in (GLOBAL_AREA, dar_key_to_cell(
+            np.arange(1100, 1125, dtype=np.int64))):
+        want = sorted(
+            i.id for i in sb.rid._local.search_isas(area, T0, None)
+        )
+        got = sorted(
+            r.id for r in m.search(
+                "isa", area, None, None,
+                int(T0.timestamp() * 1e9), None,
+                int(T0.timestamp() * 1e9),
+            )
+        )
+        assert got == want
+    assert m.counts()["isa"] == 3
+
+
+def test_stats_key_set_stable(two_regions):
+    sa, sb, oracle, ra, rb = two_regions
+    plain = DSSStore(storage="memory", clock=Clock())
+    try:
+        assert set(fed.empty_stats()) == set(ra.stats())
+        assert set(fed.empty_stats()) <= set(plain.stats())
+        assert set(ra.stats()) <= set(sa.stats())
+        st = sa.freshness_status()
+        assert st["federation"]["region"] == "a"
+        assert "b" in st["federation"]["peers"]
+        assert plain.freshness_status()["federation"] is None
+    finally:
+        plain.close()
+
+
+# -- ladder rung -------------------------------------------------------------
+
+
+def test_ladder_federation_rung_ordering():
+    lad = chaos.DegradationLadder()
+    lad.enter("federation_degraded", "peer b down")
+    assert lad.mode() == chaos.FEDERATION_DEGRADED
+    assert chaos.MESH_DEGRADED < chaos.FEDERATION_DEGRADED \
+        < chaos.REGION_LOG_DOWN
+    # local region log down outranks a remote-region partition
+    lad.enter("region_log_down", "log gone")
+    assert lad.mode() == chaos.REGION_LOG_DOWN
+    lad.exit("region_log_down")
+    assert lad.mode() == chaos.FEDERATION_DEGRADED
+    recovered = []
+    lad.on_recover("federation_degraded", lambda: recovered.append(1))
+    lad.exit("federation_degraded")
+    assert recovered == [1]
+    assert lad.mode() == chaos.HEALTHY
+
+
+# -- memoized breaker-gated epoch probe (region/client.py) -------------------
+
+
+def test_current_epoch_memoized_behind_breaker(monkeypatch):
+    from dss_tpu.region.client import RegionClient
+
+    client = RegionClient("http://127.0.0.1:9", "t", max_retries=0)
+    calls = []
+
+    def fake_request(method, url, **kw):
+        calls.append(url)
+        raise __import__("requests").exceptions.ConnectionError("down")
+
+    monkeypatch.setattr(client._session, "request", fake_request)
+    # many fence consults inside one validity window -> ONE probe
+    for _ in range(10):
+        assert client.current_epoch() == ""
+    assert len(calls) == 1
+    # breaker open -> no probe at all, even after the window expires
+    b = client._breakers.get(client.base)
+    for _ in range(5):
+        b.record_failure()
+    assert not b.allow()
+    client._epoch_probe_at = float("-inf")
+    assert client.current_epoch() == ""
+    assert len(calls) == 1
+    # adopted epoch -> pure local read forever after
+    client._epoch = "g.x"
+    monkeypatch.setattr(
+        client._session, "request",
+        lambda *a, **k: pytest.fail("network on the fast path"),
+    )
+    assert client.current_epoch() == "g.x"
+
+
+def test_current_epoch_probe_adopts(monkeypatch):
+    from dss_tpu.region.client import RegionClient
+
+    client = RegionClient("http://127.0.0.1:9", "t")
+
+    class R:
+        status_code = 200
+
+        @staticmethod
+        def json():
+            return {"epoch": "7.abc", "role": "primary"}
+
+    monkeypatch.setattr(
+        client._session, "request", lambda *a, **k: R()
+    )
+    assert client.current_epoch() == "7.abc"
+    # adopted: consistent with what _check_epoch would have done
+    assert client._epoch == "7.abc"
+
+
+# -- peer serving payload validation -----------------------------------------
+
+
+def test_serve_query_validation(two_regions):
+    sa, sb, oracle, ra, rb = two_regions
+    with pytest.raises(errors.StatusError):
+        fed.serve_query(ra, {"cls": "nope", "cells": [1]})
+    with pytest.raises(errors.StatusError):
+        fed.serve_query(ra, {"cls": "isa", "cells": []})
+    _populate(sa, sb, oracle, n_a=1, n_b=0)
+    out = fed.serve_query(ra, {
+        "cls": "isa",
+        "cells": [int(c) for c in GLOBAL_AREA],
+        "t0_ns": int(T0.timestamp() * 1e9),
+        "t1_ns": None,
+        "now_ns": int(T0.timestamp() * 1e9),
+    })
+    assert len(out["docs"]) == 1
+    assert out["freshness"]["region"] == "a"
+    assert "gen" in out["freshness"]
+
+
+# -- live-socket X-DSS-Freshness contract (satellite) ------------------------
+
+
+@pytest.fixture()
+def fed_http(two_regions):
+    """Region a behind a real HTTP socket (no auth), region b
+    in-process behind it."""
+    pytest.importorskip("aiohttp")
+    from dss_tpu.api.app import build_app
+    from dss_tpu.services.rid import RIDService
+    from dss_tpu.services.scd import SCDService
+    from tests.live_server import LiveServer
+
+    sa, sb, oracle, ra, rb = two_regions
+    app = build_app(
+        RIDService(sa.rid, sa.clock),
+        SCDService(sa.scd, sa.clock),
+        None,
+        enable_scd=True,
+        status_fn=sa.freshness_status,
+        health_fn=sa.health.mode_name,
+        federation=ra,
+    )
+    srv = LiveServer(app)
+    try:
+        yield srv, sa, sb, oracle, ra, rb
+    finally:
+        srv.stop()
+
+
+def _http_area_cells():
+    """A geographic strip whose covering spans both regions of the
+    HTTP fixture's key-split map."""
+    area = "40.0,-100.0,41.02,-100.0,41.02,-99.99,40.0,-99.99"
+    cells = geo_covering.area_to_cell_ids(area)
+    return area, cells
+
+
+def test_http_freshness_header_stale_contract(fed_http):
+    """The satellite contract: on bounded-stale cross-region reads the
+    X-DSS-Freshness header carries the serving region id, epoch,
+    generation, and `;mode=`; a request whose X-DSS-Max-Lag the mirror
+    exceeds is rejected 503, never silently served staler."""
+    import requests
+
+    srv, sa, sb, oracle, ra, rb = fed_http
+    area, cells = _http_area_cells()
+    keys = cell_to_dar_key(cells)
+    # re-anchor the fixture map's boundary into this covering so the
+    # strip genuinely spans both regions
+    mid = int(np.sort(keys)[len(keys) // 2])
+    for r in (ra, rb):
+        r.fmap.boundaries = np.array([mid], np.int32)
+    low = [int(k) for k in keys if k < mid][:4]
+    high = [int(k) for k in keys if k >= mid][:4]
+    assert low and high
+    assert sa.rid.insert_isa(_isa(900, low)) is not None
+    assert sb.rid.insert_isa(_isa(901, high)) is not None
+    assert ra.sync_peer("b")
+
+    url = srv.base + "/v1/dss/identification_service_areas"
+    r = requests.get(url, params={"area": area}, timeout=10)
+    assert r.status_code == 200, r.text
+    ids = [s["id"] for s in r.json()["service_areas"]]
+    assert sorted(ids) == sorted([_uid(900), _uid(901)])
+    h = r.headers["X-DSS-Freshness"]
+    assert "epoch=" in h and "gen=" in h
+    assert "region=a,b" in h and "fed=remote" in h
+
+    # partition b: reads fall back to the declared-lag mirror
+    ra.peers["b"].transport = _dead_transport
+    for _ in range(3):
+        ra.sync_peer("b")
+    r = requests.get(url, params={"area": area}, timeout=10)
+    assert r.status_code == 200, r.text
+    ids = [s["id"] for s in r.json()["service_areas"]]
+    assert sorted(ids) == sorted([_uid(900), _uid(901)])
+    h = r.headers["X-DSS-Freshness"]
+    assert "region=" in h and "a" in h and "b" in h
+    assert "epoch=" in h and "gen=" in h
+    assert ";mode=" in h  # federation_degraded (or stale pre-ladder)
+    assert "fed=stale" in h and "lag=" in h
+
+    # declared bound tighter than the mirror's lag -> honest 503 with
+    # Retry-After, not a silently staler answer
+    r = requests.get(
+        url, params={"area": area},
+        headers={"X-DSS-Max-Lag": "0"}, timeout=10,
+    )
+    assert r.status_code == 503, r.text
+    assert int(r.headers["Retry-After"]) >= 1
+
+    # local-airspace serving through the partition: zero 5xx
+    a_only = "40.0,-100.0,40.02,-100.0,40.02,-99.99,40.0,-99.99"
+    a_cells = geo_covering.area_to_cell_ids(a_only)
+    if np.all(cell_to_dar_key(a_cells) < mid):
+        r = requests.get(url, params={"area": a_only}, timeout=10)
+        assert r.status_code == 200
+
+    # /status surfaces the partition
+    st = requests.get(srv.base + "/status", timeout=10).json()
+    assert st["degraded_mode"] == "federation_degraded"
+    assert st["federation"]["partitioned"] is True
+
+
+def test_http_federation_peer_endpoints(fed_http):
+    import requests
+
+    srv, sa, sb, oracle, ra, rb = fed_http
+    assert sa.rid.insert_isa(_isa(920, range(0, 4))) is not None
+    r = requests.post(
+        srv.base + "/aux/v1/federation/query",
+        json={
+            "cls": "isa",
+            "cells": [int(c) for c in LOCAL_A_AREA],
+            "t0_ns": int(T0.timestamp() * 1e9),
+            "now_ns": int(T0.timestamp() * 1e9),
+        },
+        timeout=10,
+    )
+    assert r.status_code == 200, r.text
+    body = r.json()
+    assert [d["id"] for d in body["docs"]] == [_uid(920)]
+    assert body["freshness"]["region"] == "a"
+    r = requests.get(srv.base + "/aux/v1/federation/sync", timeout=10)
+    assert r.status_code == 200
+    sync = r.json()
+    assert sync["region"] == "a"
+    assert len(sync["state"]["rid"]["isas"]) == 1
+    assert set(sync["gens"]) == {
+        "isa", "rid_sub", "op", "scd_sub", "constraint"
+    }
+
+
+def test_sync_loop_thread_survives_peer_errors(two_regions):
+    """The background sync loop never dies to a peer failure of any
+    shape."""
+    sa, sb, oracle, ra, rb = two_regions
+
+    def weird(*a):
+        raise RuntimeError("not even a PeerError")
+
+    ra.peers["b"].transport = weird
+    ra.sync_interval_s = 0.01
+    ra.start()
+    try:
+        time.sleep(0.15)
+        t = ra._sync_thread
+        assert t is not None and t.is_alive()
+        assert ra.sync_failures >= 2
+    finally:
+        ra.close()
+
+
+# -- autotune scenario sweep feeds region capacity ---------------------------
+
+
+def test_scenario_shapes_deterministic_and_city_scale():
+    from dss_tpu.plan.autotune import scenario_shapes
+
+    s1 = scenario_shapes(scale=0.02, duration_s=4.0)
+    s2 = scenario_shapes(scale=0.02, duration_s=4.0)
+    assert s1 == s2  # seeded generator -> same shape set
+    assert s1["requests"] > 50
+    assert 0.3 < s1["read_frac"] < 0.95
+    # city-scale coverings are nothing like the width-8 microbench
+    assert s1["covering_cells"]["p50"] > 8
+    assert s1["covering_cells"]["p90"] >= s1["covering_cells"]["p50"]
+
+
+def test_capacity_vector_refuses_mixed_basis():
+    from dss_tpu.plan.autotune import capacity_vector
+
+    a = {"capacity_weight": 60000.0, "capacity_basis": "scenario-mix"}
+    b = {"capacity_weight": 59000.0, "capacity_basis": "scenario-mix"}
+    legacy = {"capacity_weight": 122.0}
+    v = capacity_vector([a, b])
+    assert v.shape == (2,) and v[0] == 60000.0
+    with pytest.raises(ValueError, match="mixed capacity_basis"):
+        capacity_vector([a, legacy])
+    # the vector feeds FederationMap.plan as region capacity weights
+    post_key = np.repeat(np.arange(0, 100, dtype=np.int32), 4)
+    m = fed.FederationMap.plan(
+        [fed.RegionEntry("a", capacity_weight=float(v[0])),
+         fed.RegionEntry("b", capacity_weight=float(v[1]))],
+        post_key, local="a",
+    )
+    assert len(m.boundaries) == 1
+
+
+def test_peer_4xx_does_not_open_breaker(two_regions):
+    """A peer that ANSWERS and refuses (4xx — DSS_FED_TOKEN
+    misconfig) is a config error, not a partition: the breaker stays
+    closed and the ladder never pages FEDERATION_DEGRADED for it."""
+    sa, sb, oracle, ra, rb = two_regions
+    _populate(sa, sb, oracle, n_a=1, n_b=1)
+    assert ra.sync_peer("b")
+
+    def refused(*a):
+        raise fed.PeerError("b: 401 unauthorized", transport=False)
+
+    ra.peers["b"].transport = refused
+    for _ in range(6):
+        assert not ra.sync_peer("b")
+    assert ra.peers["b"].breaker.allow()  # never opened
+    assert not sa.health.is_active("federation_degraded")
+    assert ra.peers["b"].failures >= 6
+    # reads still degrade to the mirror (the peer is unusable either
+    # way) but without the partition page
+    got = sa.rid.search_isas(GLOBAL_AREA, T0, None, allow_stale=True)
+    assert len(got) == 2
+    note = fed.take_fed_note()
+    assert note["mode"] == "stale"
