@@ -331,6 +331,17 @@ _GAUGE_VEC_LABELS = {
     "dss_fault_injected_total": "site",
     "dss_fed_peer_state": "region",
     "dss_fed_mirror_lag_s": "region",
+    # shared-memory front per-worker counters (parallel/shmring.py):
+    # the leader aggregates every worker's shm stats block so ONE
+    # scrape sees the whole front, keyed by the worker's process id
+    **{
+        f"dss_shm_worker_{name}": "process"
+        for name in (
+            "enqueued", "served", "cache_hits", "cache_misses",
+            "ring_full", "timeouts", "oversize", "proxy_fallbacks",
+            "assembly_misses", "errors", "plan_shm", "plan_proxy",
+        )
+    },
 }
 
 
@@ -360,13 +371,26 @@ _PROXY_SKIP_HEADERS = {
 }
 
 
-def make_worker_proxy_middleware(leader_url: str, follower=None):
-    """Read-worker request routing: local replica for searches, proxy
+def make_worker_proxy_middleware(leader_url: str, follower=None,
+                                 costs=None):
+    """Read-worker request routing: local serving for searches, proxy
     to the leader for everything else.  After a successful proxied
     mutation the worker waits (bounded) for its replica to reach the
     leader's WAL seq — read-your-writes for clients that keep their
-    connection (and thus this worker) across a write->search flow."""
+    connection (and thus this worker) across a write->search flow.
+
+    With the shared-memory front attached, a locally-served search
+    that cannot ride the ring (ring full, owner dead, oversized
+    payload, injected `shm.ring.enqueue` fault) raises ShmFallback —
+    caught HERE and re-served over the loopback proxy, so ring
+    saturation degrades to the old proxy cost instead of blocking or
+    erroring.  `costs` (the front's WorkerCostModel) observes the
+    measured proxy round trip of each such fallback search, so the
+    shm-vs-proxy price comparison learns the REAL loopback cost
+    instead of trusting the DSS_SHM_PROXY_MS seed forever."""
     import aiohttp as _aiohttp
+
+    from dss_tpu.dar.shmfront import ShmFallback
 
     session: dict = {}
 
@@ -385,10 +409,15 @@ def make_worker_proxy_middleware(leader_url: str, follower=None):
             else None
         )
         canonical = resource.canonical if resource is not None else None
+        fell_back = False
         if (request.method, canonical) in WORKER_LOCAL_ROUTES:
-            return await handler(request)
+            try:
+                return await handler(request)
+            except ShmFallback:
+                fell_back = True  # loopback proxy below
         sess = await _get_session()
         body = await request.read()
+        t0 = time.perf_counter()
         headers = {
             k: v
             for k, v in request.headers.items()
@@ -407,6 +436,11 @@ def make_worker_proxy_middleware(leader_url: str, follower=None):
             return _error_response(
                 errors.unavailable(f"write leader unreachable: {e}")
             )
+        if fell_back and costs is not None:
+            # a fallback-proxied SEARCH is the exact request shape the
+            # ring would have served — feed its measured round trip to
+            # the worker cost model (writes/other routes would skew it)
+            costs.observe_proxy((time.perf_counter() - t0) * 1000.0)
         if (
             follower is not None
             and seq

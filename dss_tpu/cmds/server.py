@@ -256,6 +256,17 @@ def make_parser() -> argparse.ArgumentParser:
         help=argparse.SUPPRESS,  # internal: leader base URL for proxying
     )
     p.add_argument(
+        "--shm_region",
+        default="",
+        help=argparse.SUPPRESS,  # internal: shared-memory ring region path
+    )
+    p.add_argument(
+        "--shm_worker_index",
+        type=int,
+        default=-1,
+        help=argparse.SUPPRESS,  # internal: this worker's ring index
+    )
+    p.add_argument(
         "--follower_poll_interval",
         type=float,
         default=0.02,
@@ -333,10 +344,47 @@ def build_worker(args) -> web.Application:
         "read worker up: replica from %s every %.0f ms, leader %s",
         args.wal_path, args.follower_poll_interval * 1000, args.leader_url,
     )
-    rid = RIDService(store.rid, clock)
-    scd = SCDService(store.scd, clock) if args.enable_scd else None
+    rid_store, scd_store = store.rid, store.scd
+    front = None
+    if args.shm_region:
+        # shared-memory serving front (parallel/shmring.py): searches
+        # ride the query ring to the device owner — with a worker-
+        # local version-fenced read cache answering repeat polls in
+        # microseconds — instead of re-scanning the WAL-tail replica.
+        # The replica stays: record assembly + proxy-fallback serving.
+        from dss_tpu.dar.shmfront import (
+            ShmRIDStore, ShmSCDStore, ShmSearchFront,
+        )
+        from dss_tpu.parallel import shmring
+
+        region = shmring.ShmRegion.open_existing(args.shm_region)
+        client = shmring.ShmWorkerClient(
+            region, args.shm_worker_index
+        )
+        front = ShmSearchFront(
+            region, client, follower, clock,
+            catchup_s=float(os.environ.get("DSS_SHM_CATCHUP_S", 1.0)),
+            owner_ttl_s=float(
+                os.environ.get("DSS_SHM_OWNER_TTL_S", 5.0)
+            ),
+            owner_threads=int(
+                os.environ.get("DSS_SHM_OWNER_THREADS", 0)
+            ) or min(4, max(2, os.cpu_count() or 2)),
+        )
+        rid_store = ShmRIDStore(store.rid, front)
+        scd_store = ShmSCDStore(store.scd, front)
+        log.info(
+            "shm front: worker %d of %d on %s (depth %d, slot %d B)",
+            args.shm_worker_index, region.nworkers, args.shm_region,
+            region.depth, region.slot_bytes,
+        )
+    rid = RIDService(rid_store, clock)
+    scd = SCDService(scd_store, clock) if args.enable_scd else None
     authorizer = _make_authorizer(args)
-    metrics = MetricsRegistry(proc=f"worker:{os.getpid()}")
+    metrics = MetricsRegistry(
+        proc=f"worker-{args.shm_worker_index}:{os.getpid()}"
+        if args.shm_region else f"worker:{os.getpid()}"
+    )
     from dss_tpu.build_info import build_info
 
     metrics.set_info("dss_build_info", build_info())
@@ -344,6 +392,8 @@ def build_worker(args) -> web.Application:
     def stats_fn():
         out = store.stats()
         out.update(follower.stats())
+        if front is not None:
+            out.update(front.stats())
         return out
 
     app = build_app(
@@ -358,9 +408,20 @@ def build_worker(args) -> web.Application:
         health_fn=store.health.mode_name,
         default_timeout_s=args.default_timeout,
         trace_requests=args.trace_requests,
-        inline_reads=_inline_reads(args),
+        # ring waits block their thread: searches must stay on the
+        # executor, never the event loop, when the front is attached
+        # shm-front workers run optimistic inline reads regardless of
+        # core count: a worker-cache hit is microseconds on the event
+        # loop, and the front raises NeedsDevice before anything that
+        # blocks (ring round trip, replica catchup) so misses re-run
+        # on the executor — see ShmSearchFront.serve
+        inline_reads=(
+            args.inline_reads != "off" if args.shm_region
+            else _inline_reads(args)
+        ),
         worker_proxy=make_worker_proxy_middleware(
-            args.leader_url, follower=follower
+            args.leader_url, follower=follower,
+            costs=front.costs if front is not None else None,
         ),
     )
     # the worker's boot heap is the initially-replayed WAL; tail
@@ -691,6 +752,9 @@ def build(args) -> web.Application:
         # proxied mutation
         wal_seq_fn=(lambda: store.wal.seq) if args.workers > 0 else None,
     )
+    # main() attaches the shared-memory front to the store (workers
+    # mode) after the listen sockets exist
+    app["dss_store"] = store
 
     # park the boot heap outside GC scans once boot actually finishes:
     # after the background warmup compile (its caches are part of the
@@ -753,7 +817,7 @@ def _watch_parent():
     threading.Thread(target=loop, name="parent-watch", daemon=True).start()
 
 
-def _forward_args(args, leader_url: str):
+def _forward_args(args, leader_url: str, worker_index: int = -1):
     """argv for a read-worker child."""
     out = [
         "--worker_reader",
@@ -766,6 +830,11 @@ def _forward_args(args, leader_url: str):
         "--follower_poll_interval", str(args.follower_poll_interval),
         "--inline_reads", args.inline_reads,
     ]
+    if getattr(args, "_shm_path", ""):
+        out += [
+            "--shm_region", args._shm_path,
+            "--shm_worker_index", str(worker_index),
+        ]
     if args.enable_scd:
         out.append("--enable_scd")
     if args.insecure_no_auth:
@@ -871,22 +940,119 @@ def main():
             args.wal_path = os.path.join(
                 tempfile.mkdtemp(prefix="dss-wal-"), "wal.jsonl"
             )
+        # shared-memory serving front (parallel/shmring.py), on by
+        # default: the region file must exist BEFORE workers boot.
+        # DSS_SHM_ENABLE=0 falls back to plain WAL-tail workers.
+        from dss_tpu.dar.coalesce import _env_bool
+        from dss_tpu.parallel import shmring
+
+        shm_raw = os.environ.get("DSS_SHM_ENABLE")
+        shm_enable = True if shm_raw is None else _env_bool(shm_raw)
+        shm_path = ""
+        region = None
+        if shm_enable:
+            shm_path = os.path.join(
+                tempfile.mkdtemp(prefix="dss-shm-"), "ring.shm"
+            )
+            region = shmring.ShmRegion.create(
+                shm_path, nworkers=args.workers, **shmring.env_knobs()
+            )
+        args._shm_path = shm_path
         app = build(args)
-        # leader listens on the shared public port AND a loopback port
-        # the workers proxy writes to
-        pub = _public_socket(args.addr, reuse_port=True)
+        owner = None
+        if region is not None:
+            owner = app["dss_store"].attach_shm_front(
+                region,
+                threads=int(
+                    os.environ.get("DSS_SHM_OWNER_THREADS", 0)
+                ) or None,
+                worker_ttl_s=float(
+                    os.environ.get("DSS_SHM_WORKER_TTL_S", 5.0)
+                ),
+            )
+        # With the shm front attached the leader is a PURE device
+        # owner: it serves the ring plus the loopback port the workers
+        # proxy writes to, and leaves the public port entirely to the
+        # workers.  A public connection landing on the leader would be
+        # served at single-process latency AND steal owner CPU from
+        # the ring drain — measured, that one topology leak capped the
+        # whole front near the r06 ceiling.  Plain SO_REUSEPORT mode
+        # (DSS_SHM_ENABLE=0) keeps the historical shared public bind.
         internal = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         internal.bind(("127.0.0.1", 0))
         internal.listen(1024)
         leader_url = f"http://127.0.0.1:{internal.getsockname()[1]}"
-        children = []
-        child_argv = [
-            sys.executable, "-m", "dss_tpu.cmds.server",
-        ] + _forward_args(args, leader_url)
-        for _ in range(args.workers):
-            children.append(subprocess.Popen(child_argv))
+        if region is not None:
+            leader_socks = [internal]
+        else:
+            leader_socks = [
+                _public_socket(args.addr, reuse_port=True), internal,
+            ]
+        def spawn_worker(i):
+            return subprocess.Popen(
+                [sys.executable, "-m", "dss_tpu.cmds.server"]
+                + _forward_args(args, leader_url, worker_index=i)
+            )
+
+        children = [spawn_worker(i) for i in range(args.workers)]
+        stopping = threading.Event()
+
+        # a dead worker's in-flight ring slots are reclaimed the
+        # moment the leader reaps it (the heartbeat TTL is the
+        # backstop for a wedged-but-alive worker), and the worker is
+        # RESPAWNED: with the shm front on, the leader leaves the
+        # public port entirely to the workers, so an unreplaced crash
+        # would permanently shrink — and at zero workers eliminate —
+        # the service's public listeners.  A crash-looping worker
+        # (died within 10s of spawn) backs off exponentially to 30s;
+        # one that served a while restarts on the next tick.
+        def watch_children():
+            import time as _time
+
+            from dss_tpu.obs.logging import get_logger
+
+            log = get_logger("dss.server")
+            backoff = [0.5] * len(children)
+            respawn_at = [0.0] * len(children)
+            spawned_at = [_time.monotonic()] * len(children)
+            dead: set = set()
+            while not stopping.is_set():
+                now = _time.monotonic()
+                for i, c in enumerate(children):
+                    if c.poll() is None:
+                        continue
+                    if i not in dead:
+                        dead.add(i)
+                        freed = (
+                            owner.reclaim_worker(i)
+                            if owner is not None else 0
+                        )
+                        if now - spawned_at[i] < 10.0:
+                            backoff[i] = min(backoff[i] * 2, 30.0)
+                        else:
+                            backoff[i] = 0.5
+                        respawn_at[i] = now + backoff[i]
+                        log.warning(
+                            "worker %d exited (rc=%s); reclaimed %d "
+                            "in-flight shm slots; respawn in %.1fs",
+                            i, c.returncode, freed, backoff[i],
+                        )
+                    elif now >= respawn_at[i] and not stopping.is_set():
+                        children[i] = spawn_worker(i)
+                        spawned_at[i] = _time.monotonic()
+                        dead.discard(i)
+                        log.warning(
+                            "worker %d respawned (pid %d)",
+                            i, children[i].pid,
+                        )
+                _time.sleep(0.5)
+
+        threading.Thread(
+            target=watch_children, name="worker-watch", daemon=True
+        ).start()
 
         def reap():
+            stopping.set()
             for c in children:
                 if c.poll() is None:
                     c.terminate()
@@ -899,7 +1065,7 @@ def main():
         atexit.register(reap)
         web.run_app(
             app,
-            sock=[pub, internal],
+            sock=leader_socks,
             shutdown_timeout=args.shutdown_grace,
         )
         return

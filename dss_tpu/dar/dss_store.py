@@ -183,7 +183,10 @@ class _CachedSearchMixin:
             # cache a key nobody repeats cheaply
             or len(cells) > 16384
         ):
-            return run()
+            rcache.take_mesh_served()
+            ids = run()
+            rcache.note_last_search_meshed(rcache.take_mesh_served())
+            return ids
         epoch = self._epoch_fn()
         fence = clock_fence(cells)
         key = (cls, owner_id, qkey, cells.tobytes())
@@ -192,10 +195,13 @@ class _CachedSearchMixin:
         )
         if ids is not None:
             rcache.note_search(cls, epoch, fence[2], True)
+            rcache.note_last_search_meshed(False)
             return ids
         rcache.take_mesh_served()  # clear any stale flag before running
         ids = run()
-        if not rcache.take_mesh_served():
+        meshed = rcache.take_mesh_served()
+        rcache.note_last_search_meshed(meshed)
+        if not meshed:
             pairs_ids: List[str] = []
             t1s: List[int] = []
             for i in ids:
@@ -405,9 +411,15 @@ class RIDStoreImpl(_TxnTimeMixin, _CachedSearchMixin, RIDStore):
         cells = canonical_cells(cells)
         e_ns = to_nanos(earliest)
         l_ns = None if latest is None else to_nanos(latest)
+        # `earliest` is the query's `now` (the service clamps past
+        # starts to the wall clock), and its ONLY effect on the result
+        # is the t_end >= earliest expiry filter — which the cache
+        # re-applies at now_ns on every hit.  Keying it would stamp
+        # the wall clock into the key and make every repeat poll a
+        # unique, never-hit line; only `latest` shapes the entry.
         ids = self._cached_ids(
             "isa", self._isa_index, cells,
-            qkey=(e_ns, l_ns), now_ns=e_ns, allow_stale=allow_stale,
+            qkey=(l_ns,), now_ns=e_ns, allow_stale=allow_stale,
             run=lambda: self._isa_index.query_ids(
                 cells, t_start=e_ns, t_end=l_ns, now=e_ns,
                 allow_stale=allow_stale,
@@ -1375,6 +1387,9 @@ class DSSStore:
         # router; stats() exports the stable dss_fed_* key set either
         # way so dashboards never miss a series
         self.federation = None
+        # shared-memory serving front (parallel/shmring.py): None
+        # until attach_shm_front makes this process the device owner
+        self._shm_owner = None
         self._replaying = False
         if region_url:
             self.region = RegionCoordinator(
@@ -1481,6 +1496,150 @@ class DSSStore:
                 n += warm(loop.kernel)
         return n
 
+    # -- shared-memory serving front (parallel/shmring.py) -------------------
+
+    def _class_index(self, cls: str):
+        return {
+            "isa": self.rid._isa_index,
+            "rid_sub": self.rid._sub_index,
+            "op": self.scd._op_index,
+            "scd_sub": self.scd._sub_index,
+            "constraint": self.scd._cst_index,
+        }[cls]
+
+    def shm_serve(self, req) -> Tuple[List[str], List[int], int, int]:
+        """Serve one shared-memory ring request (shmring.ShmRequest)
+        through the SAME search paths HTTP requests take — admission,
+        deadline routing, the planner, and the owner's read cache all
+        apply — returning (ids, t_end ns per id, class generation,
+        response flags).  The flags carry RESP_F_MESH_SERVED when the
+        answer came from the bounded-stale mesh replica: the leader
+        refuses to populate its own cache from such answers
+        (_cached_ids), and the requesting worker must refuse too.
+
+        Visibility is pinned to the WORKER's `now`: the request's
+        clock instant rides the txn-time thread-local, so the answer
+        is bit-identical to what the worker's own fresh path would
+        have computed at that instant (expiry included).  The
+        backwards-clock guards in the read cache already handle
+        out-of-order nows across workers — this is the same contract
+        as a txn-pinned precheck behind live pollers."""
+        from dss_tpu.clock import from_nanos
+
+        cls = req.cls
+        cells = canonical_cells(req.cells)
+        sub = self.rid if cls in ("isa", "rid_sub") else self.scd
+        tl = sub._txn_time
+        pinned = getattr(tl, "now", None) is None
+        if pinned:
+            tl.now = int(req.now_ns)
+        try:
+            if cls == "isa":
+                recs = sub.search_isas(
+                    cells, from_nanos(req.t0_ns),
+                    None if req.t1_ns is None else from_nanos(req.t1_ns),
+                    allow_stale=req.allow_stale,
+                )
+            elif cls == "rid_sub":
+                recs = (
+                    sub.search_subscriptions_by_owner(cells, req.owner)
+                    if req.owner
+                    else sub.search_subscriptions(cells)
+                )
+            elif cls == "op":
+                recs = sub.search_operations(
+                    cells, req.alt_lo, req.alt_hi,
+                    None if req.t0_ns is None else from_nanos(req.t0_ns),
+                    None if req.t1_ns is None else from_nanos(req.t1_ns),
+                    allow_stale=req.allow_stale,
+                )
+            elif cls == "constraint":
+                recs = sub.search_constraints(
+                    cells, req.alt_lo, req.alt_hi,
+                    None if req.t0_ns is None else from_nanos(req.t0_ns),
+                    None if req.t1_ns is None else from_nanos(req.t1_ns),
+                    allow_stale=req.allow_stale,
+                )
+            elif cls == "scd_sub":
+                # id-level serve: the worker resolves each sub's
+                # dependent operations itself (through its own cached
+                # op path), so the slot never carries nested lists
+                now = int(req.now_ns)
+                oid = (
+                    sub._owners.intern(req.owner)
+                    if req.owner else None
+                )
+                ids = sub._cached_ids(
+                    "scd_sub", sub._sub_index, cells,
+                    qkey=(), now_ns=now, allow_stale=False,
+                    run=lambda: sub._sub_index.query_ids(
+                        cells, now=now, owner_id=oid
+                    ),
+                    t_end_of=sub._scd_sub_t_end,
+                    owner_id=oid,
+                )
+                out_ids, t1s = [], []
+                for i in sorted(ids):
+                    t1 = sub._scd_sub_t_end(i)
+                    if t1 is None:
+                        continue
+                    out_ids.append(i)
+                    t1s.append(t1)
+                gen = sub._sub_index.cell_clock.generation
+                return out_ids, t1s, gen, self._shm_resp_flags()
+            else:
+                raise errors.bad_request(f"unknown shm class {cls!r}")
+        finally:
+            if pinned:
+                tl.now = None
+        gen = self._class_index(cls).cell_clock.generation
+        _never = np.iinfo(np.int64).max
+        return (
+            [r.id for r in recs],
+            # a record with no end time never expires: int64 max keeps
+            # the worker cache's t_end-refilter a no-op for it
+            [
+                _never if r.end_time is None else to_nanos(r.end_time)
+                for r in recs
+            ],
+            gen,
+            self._shm_resp_flags(),
+        )
+
+    @staticmethod
+    def _shm_resp_flags() -> int:
+        from dss_tpu.parallel import shmring
+
+        return (
+            shmring.RESP_F_MESH_SERVED
+            if rcache.take_last_search_meshed() else 0
+        )
+
+    def attach_shm_front(self, region, *, threads: int = None,
+                         worker_ttl_s: float = 5.0):
+        """Make this store the device owner of a shared-memory serving
+        front: every entity class's cell clock broadcasts its bumps
+        into the region's fence segment, and a ShmOwner drain serves
+        ring requests through shm_serve.  Returns the started owner
+        (the caller — cmds/server.py — reclaims dead workers' slots
+        via owner.reclaim_worker)."""
+        from dss_tpu.parallel import shmring
+
+        if self._shm_owner is not None:
+            raise RuntimeError("shm front already attached")
+        for idx, cls in enumerate(shmring.SHM_CLASSES):
+            self._class_index(cls).cell_clock.attach_mirror(
+                shmring.FenceMirror(region, idx)
+            )
+        owner = shmring.ShmOwner(
+            region, self.shm_serve, threads=threads,
+            wal_seq_fn=lambda: self.wal.seq,
+            worker_ttl_s=worker_ttl_s,
+        )
+        owner.start()
+        self._shm_owner = owner
+        return owner
+
     def attach_federation(self, router) -> None:
         """Put the multi-region FederationRouter in front of the
         store: binds the UNWRAPPED sub-stores for peer-facing serving
@@ -1553,6 +1712,8 @@ class DSSStore:
             use_load(self.range_load)
 
     def close(self):
+        if self._shm_owner is not None:
+            self._shm_owner.close()
         if self.federation is not None:
             self.federation.close()
         if self.region is not None:
@@ -1610,6 +1771,14 @@ class DSSStore:
             out.update(self.federation.stats())
         else:
             out.update(_fedmod.empty_stats())
+        # shared-memory front gauges: same stable-key-set discipline
+        # (per-worker counters render as dss_shm_worker_*{process})
+        from dss_tpu.parallel import shmring as _shmmod
+
+        if self._shm_owner is not None:
+            out.update(self._shm_owner.stats())
+        else:
+            out.update(_shmmod.empty_stats())
         if self.region is not None:
             out.update(self.region.stats())
         return out

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import Optional
 
 from dss_tpu.parallel.replica import _WalTail
@@ -32,6 +33,9 @@ class WalFollower:
         self._apply_errors = 0
         self._stop = threading.Event()
         self._seq_cond = threading.Condition()
+        # serializes tail reads: the background loop and wait_for's
+        # active catchup share one _WalTail (stateful file offset)
+        self._poll_mutex = threading.Lock()
         self._thread: Optional[threading.Thread] = None
 
     @property
@@ -68,20 +72,46 @@ class WalFollower:
 
     def wait_for(self, seq: int, timeout_s: float = 1.0) -> bool:
         """Block until the replica has applied WAL seq >= seq (the
-        read-your-writes courtesy after a proxied mutation).  False on
-        timeout — the caller proceeds with bounded staleness."""
-        with self._seq_cond:
-            return bool(
+        read-your-writes courtesy after a proxied mutation, and the
+        shm ring's record-assembly bound).  False on timeout — the
+        caller proceeds with bounded staleness.
+
+        Catchup is ACTIVE: a behind caller pulls the tail itself
+        instead of sleeping until the next background tick, so the
+        wait is bounded by a page-cache file read (the target records
+        are already appended — the leader's seq only moves after the
+        append), not by the poll interval.  Under a miss burst the
+        mutex collapses concurrent pullers into one read; the rest
+        wake on the same seq condition."""
+        if self._applied_seq >= seq:
+            return True
+        deadline = time.monotonic() + timeout_s
+        while True:
+            if self._poll_mutex.acquire(timeout=0.005):
+                try:
+                    if self._applied_seq < seq:
+                        self.poll_once()
+                except Exception:  # noqa: BLE001 — keep serving
+                    log.exception("active catchup poll failed")
+                finally:
+                    self._poll_mutex.release()
+            if self._applied_seq >= seq:
+                return True
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return self._applied_seq >= seq
+            with self._seq_cond:
                 self._seq_cond.wait_for(
-                    lambda: self._applied_seq >= seq, timeout_s
+                    lambda: self._applied_seq >= seq,
+                    min(remaining, 0.02),
                 )
-            ) or self._applied_seq >= seq
 
     def start(self) -> None:
         def loop():
             while not self._stop.wait(self._interval):
                 try:
-                    self.poll_once()
+                    with self._poll_mutex:
+                        self.poll_once()
                 except Exception:  # noqa: BLE001 — keep the tailer alive
                     log.exception("follower poll failed")
 

@@ -385,3 +385,20 @@ def take_mesh_served() -> bool:
     m = getattr(_tls, "mesh", False)
     _tls.mesh = False
     return bool(m)
+
+
+def note_last_search_meshed(meshed: bool) -> None:
+    """Sticky per-thread record of whether the MOST RECENT search on
+    this thread was mesh-served (bounded-stale).  take_mesh_served is
+    consumed inside _cached_ids to gate the leader's own cache
+    population; this flag survives one level up so the shm owner can
+    tell the REQUESTING WORKER not to populate its cache either —
+    otherwise a lagging mesh answer would be stamped fresh behind a
+    fence that cannot detect it."""
+    _tls.last_mesh = bool(meshed)
+
+
+def take_last_search_meshed() -> bool:
+    m = getattr(_tls, "last_mesh", False)
+    _tls.last_mesh = False
+    return bool(m)

@@ -64,11 +64,15 @@ def _esc_label(v) -> str:
 
 class MetricsRegistry:
     def __init__(self, proc: str = None):
-        # proc: constant label stamped on every series.  REQUIRED in
-        # multi-process serving (--workers): the processes share one
-        # port via SO_REUSEPORT, so consecutive scrapes land on
-        # different processes' registries — without a distinguishing
-        # label the series would appear to reset on every scrape.
+        # proc: constant `process` label stamped on every series.
+        # REQUIRED in multi-process serving (--workers): the processes
+        # share one port via SO_REUSEPORT, so consecutive scrapes land
+        # on different processes' registries — without a
+        # distinguishing label the series would appear to reset on
+        # every scrape.  The leader additionally aggregates every
+        # worker's shm stats block into dss_shm_worker_*{process}
+        # families (parallel/shmring.ShmOwner.stats), so one scrape of
+        # ANY process sees the whole front's counters coherently.
         self._proc = proc
         self._lock = threading.Lock()
         self._counters: Dict[Tuple[str, str, int], int] = {}
@@ -147,7 +151,7 @@ class MetricsRegistry:
         lines = []
         pl = (
             "" if self._proc is None
-            else f'proc="{_esc_label(self._proc)}"'
+            else f'process="{_esc_label(self._proc)}"'
         )
 
         def lab(extra: str) -> str:
@@ -226,6 +230,11 @@ class MetricsRegistry:
             for name, (label, vals) in sorted(self._gauge_vecs.items()):
                 lines.append(f"# TYPE {name} gauge")
                 for k, v in sorted(vals.items()):
-                    l = lab(f'{_esc_label(label)}="{_esc_label(k)}"')
+                    l = f'{_esc_label(label)}="{_esc_label(k)}"'
+                    # a family keyed BY process (the leader's
+                    # aggregated shm worker counters) already carries
+                    # the label the constant would duplicate
+                    if label != "process":
+                        l = lab(l)
                     lines.append(f"{name}{{{l}}} {v}")
         return "\n".join(lines) + "\n"

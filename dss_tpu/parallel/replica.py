@@ -412,6 +412,26 @@ class ShardedReplica:
         self._warm_ms_total = 0.0  # publish-gating warm time (compile
         #                            + layout commit per rebuild)
         self._last_fresh = 0.0  # monotonic time of last caught-up sync
+        # -- demand-paced refresh ----------------------------------------------
+        # The dar rebuild + publish-gating warm is the expensive half
+        # of a sync tick; on a small host it can eat a third of total
+        # serving capacity keeping a mesh replica fresh that no query
+        # is using.  The background loop therefore always applies the
+        # cheap tail (writes keep accumulating), but only rebuilds
+        # while a mesh-shaped batch has consulted fresh() within the
+        # pace window (or during the boot grace, so the first demanded
+        # query finds a warm replica).  An idle replica goes stale by
+        # construction, fresh() then steers the planner local, and the
+        # SAME fresh() probe is the demand signal that resumes
+        # rebuilding — one or two ticks later the mesh route is warm
+        # again.  Pace <= 0 restores the historical always-rebuild
+        # loop (multihost lockstep never runs this loop and is
+        # unaffected).
+        raw_pace = os.environ.get("DSS_REPLICA_DEMAND_PACE_S", "")
+        self.demand_pace_s = float(raw_pace) if raw_pace else 10.0
+        self._demand_last = 0.0
+        self._started_at = 0.0
+        self._refresh_skips = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -974,11 +994,27 @@ class ShardedReplica:
 
     def start(self, interval_s: float = 0.5) -> None:
         self._interval_s = interval_s
+        self._started_at = time.monotonic()
 
         def loop():
             while not self._stop.wait(interval_s):
                 try:
-                    self.sync()
+                    self.poll_once()
+                    if self._refresh_due():
+                        self.refresh()
+                    else:
+                        self._refresh_skips += 1
+                        # an idle replica with NOTHING to fold is still
+                        # current — the tail is applied and no class is
+                        # dirty — so keep the staleness clock honest
+                        # instead of letting it climb into the stale
+                        # alert at quiescent steady state (deferred-
+                        # backlog idleness is excused in the alert via
+                        # replica_demand_idle instead)
+                        with self._mu:
+                            backlog = any(self._dirty.values())
+                        if not backlog and not self._has_tail_errors():
+                            self._last_fresh = time.monotonic()
                 except Exception:  # noqa: BLE001 — keep the tailer alive
                     log.exception("replica refresh failed")
 
@@ -986,6 +1022,20 @@ class ShardedReplica:
             target=loop, name="sharded-replica", daemon=True
         )
         self._thread.start()
+
+    def _refresh_due(self) -> bool:
+        """Demand pacing: rebuild only while the mesh route has a
+        consumer (fresh() consulted within the pace window) or during
+        the boot grace.  The tail is ALWAYS applied by the loop before
+        this check, so skipping a rebuild defers work, never loses it
+        — the first demanded refresh folds the whole backlog."""
+        pace = self.demand_pace_s
+        if pace <= 0:
+            return True
+        now = time.monotonic()
+        if now - self._started_at <= pace:
+            return True  # boot grace: warm before the first demand
+        return now - self._demand_last <= pace
 
     def close(self) -> None:
         self._stop.set()
@@ -1009,6 +1059,11 @@ class ShardedReplica:
         staleness as any non-writing region instance."""
         if bound_s is None:
             bound_s = 4 * getattr(self, "_interval_s", 0.5)
+        # a freshness probe IS the demand signal: a mesh-shaped batch
+        # wanted this replica, so the paced background loop resumes
+        # rebuilding (a stale answer here steers the caller local and
+        # the route re-warms within a tick or two)
+        self._demand_last = time.monotonic()
         if self.staleness_s() > bound_s:
             return False
         if any(self._dirty.values()):
@@ -1237,6 +1292,12 @@ class ShardedReplica:
             "replica_delta_refreshes": self._delta_refreshes,
             "replica_major_rebuilds": self._major_rebuilds,
             "replica_warm_ms_total": round(self._warm_ms_total, 1),
+            "replica_refresh_skips": self._refresh_skips,
+            "replica_demand_idle": int(
+                self.demand_pace_s > 0
+                and self._started_at > 0
+                and not self._refresh_due()
+            ),
             "replica_staleness_s": (
                 -1.0
                 if self._last_fresh == 0.0

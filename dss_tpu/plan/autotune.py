@@ -61,6 +61,12 @@ KNOB_KEYS = (
     "DSS_RES_BATCH_BUCKETS",
     "DSS_RES_WINDOW_BUCKETS",
     "DSS_SHARD_RESULTS",
+    # shared-memory serving front geometry + the worker cost-model
+    # seed (parallel/shmring.py / plan/shmroute.py), measured by
+    # measure_shm's ring sweep
+    "DSS_SHM_DEPTH",
+    "DSS_SHM_SLOT_BYTES",
+    "DSS_SHM_RTT_MS",
 )
 
 HOUR = 3_600_000_000_000
@@ -253,6 +259,122 @@ def measure_resident(ft, n_cells: int, *, depths=(2, 4, 8),
     }
 
 
+def measure_shm(*, depths=(16, 64, 256),
+                slot_bytes=(16384, 32768, 65536),
+                calls: int = 200, threads: int = 4,
+                covering: int = 128, hits: int = 32) -> Dict[str, object]:
+    """Shared-memory ring sweep (parallel/shmring.py): measured round
+    trips through a REAL region file + owner drain with a trivial
+    serve_fn, so the number is the IPC mechanics (slot codec, publish,
+    scan, wake) and nothing else.
+
+    DSS_SHM_DEPTH is the knee of the concurrent-throughput ladder (the
+    smallest depth within 5% of the best aggregate qps — deeper rings
+    buy nothing but memory and reclaim scans).  DSS_SHM_SLOT_BYTES is
+    the smallest slot within 10% of the best serial RTT that still
+    fits 4x the representative covering (headroom for bulk searches
+    before the proxy fallback).  DSS_SHM_RTT_MS seeds the worker
+    front's shm-vs-proxy cost model (plan/shmroute.WorkerCostModel)."""
+    import tempfile
+    import threading as _threading
+
+    from dss_tpu.parallel import shmring
+
+    ids = [f"00000000-0000-4000-8000-{i:012d}" for i in range(hits)]
+    t1s = list(range(hits))
+    cells = np.arange(covering, dtype=np.uint64)
+
+    def serve(req):
+        return ids, t1s, 1
+
+    def _run(depth: int, slot: int):
+        d = tempfile.mkdtemp(prefix="dss-shm-sweep-")
+        path = os.path.join(d, "ring.shm")
+        region = shmring.ShmRegion.create(
+            path, nworkers=1, depth=depth, slot_bytes=slot,
+            fence_slots=1 << 12,
+        )
+        owner = shmring.ShmOwner(region, serve, threads=2)
+        owner.start()
+        wregion = shmring.ShmRegion.open_existing(path)
+        client = shmring.ShmWorkerClient(wregion, 0, wait_s=10.0)
+        try:
+            for _ in range(10):  # page-fault + path warm
+                client.call(cls="isa", cells=cells, now_ns=NOW)
+            lat = []
+            for _ in range(calls // 4):
+                t0 = time.perf_counter()
+                client.call(cls="isa", cells=cells, now_ns=NOW)
+                lat.append(time.perf_counter() - t0)
+            rtt_ms = _median_ms(lat)
+
+            per_thread = max(1, calls // threads)
+
+            def worker():
+                for _ in range(per_thread):
+                    try:
+                        client.call(
+                            cls="isa", cells=cells, now_ns=NOW
+                        )
+                    except shmring.RingFull:
+                        pass
+
+            t0 = time.perf_counter()
+            ths = [
+                _threading.Thread(target=worker)
+                for _ in range(threads)
+            ]
+            for t in ths:
+                t.start()
+            for t in ths:
+                t.join()
+            qps = (threads * per_thread) / max(
+                time.perf_counter() - t0, 1e-9
+            )
+            return rtt_ms, qps
+        finally:
+            client.close()
+            owner.close()
+            wregion.close()
+            region.close()
+            try:
+                os.unlink(path)
+                os.rmdir(d)
+            except OSError:
+                pass
+
+    mid_slot = slot_bytes[len(slot_bytes) // 2]
+    by_depth = {d: _run(d, mid_slot) for d in depths}
+    best_qps = max(q for _, q in by_depth.values())
+    knee_depth = next(
+        d for d in sorted(by_depth)
+        if by_depth[d][1] >= 0.95 * best_qps
+    )
+    by_slot = {s: _run(knee_depth, s)[0] for s in slot_bytes}
+    fits = [
+        s for s in sorted(by_slot)
+        if s >= 4 * covering * 8 + 256
+    ] or [max(slot_bytes)]
+    best_rtt = min(by_slot[s] for s in fits)
+    slot_pick = next(
+        s for s in sorted(fits) if by_slot[s] <= 1.1 * best_rtt
+    )
+    return {
+        "rtt_ms_by_depth": {
+            str(d): round(r, 4) for d, (r, _) in by_depth.items()
+        },
+        "qps_by_depth": {
+            str(d): round(q, 1) for d, (_, q) in by_depth.items()
+        },
+        "rtt_ms_by_slot": {
+            str(s): round(r, 4) for s, r in by_slot.items()
+        },
+        "depth": int(knee_depth),
+        "slot_bytes": int(slot_pick),
+        "rtt_ms": round(by_depth[knee_depth][0], 4),
+    }
+
+
 def measure_hit_concentration(ft, n_cells: int, *, batch: int = 256,
                               max_results: int = 512) -> Dict[str, int]:
     """Per-query unique-hit distribution of the synthetic workload:
@@ -431,6 +553,12 @@ def autotune(*, quick: bool = False, entities: Optional[int] = None,
             batch=128, window_bucket=256,
         )
         conc = measure_hit_concentration(ft, n_cel)
+        shm = measure_shm(
+            depths=(16, 64) if quick else (16, 64, 256),
+            slot_bytes=(16384, 32768) if quick
+            else (16384, 32768, 65536),
+            calls=60 if quick else 200,
+        )
         if scenario:
             # city-scale load shapes from the scenario generator
             # (ROADMAP PR 12 follow-on): the mixed-workload sweep that
@@ -468,6 +596,9 @@ def autotune(*, quick: bool = False, entities: Optional[int] = None,
         "DSS_RES_BATCH_BUCKETS": batch_buckets,
         "DSS_RES_WINDOW_BUCKETS": window_buckets,
         "DSS_SHARD_RESULTS": conc["shard_results"],
+        "DSS_SHM_DEPTH": shm["depth"],
+        "DSS_SHM_SLOT_BYTES": shm["slot_bytes"],
+        "DSS_SHM_RTT_MS": shm["rtt_ms"],
     }
     # this host's relative serving capacity: with the scenario sweep,
     # the measured city-scale mixed-workload qps scalar (the same
@@ -485,6 +616,7 @@ def autotune(*, quick: bool = False, entities: Optional[int] = None,
         "device": dev,
         "resident": res,
         "hit_concentration": conc,
+        "shm_ring": shm,
     }
     if scen_ms is not None:
         measurements["scenario"] = dict(scen_ms, shapes=scen_shapes)

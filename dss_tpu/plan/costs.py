@@ -18,6 +18,7 @@ __all__ = [
     "predict_host_ms",
     "predict_resident_latency_ms",
     "predict_resident_ms",
+    "predict_shm_ms",
 ]
 
 
@@ -51,6 +52,21 @@ def predict_resident_latency_ms(
     # removes it) plus a floor of queue wait per batch ahead.  Use
     # for headroom (deadline) comparisons.
     return res_lat_ms + res_floor_ms * max(0, int(inflight)) + item_ms * n
+
+
+def predict_shm_ms(
+    rtt_ms: float, owner_serve_ms: float, inflight: int = 0,
+    owner_threads: int = 2,
+) -> float:
+    # shared-memory ring round trip (parallel/shmring.py): one slot
+    # publish + owner turnaround + response spin.  Requests already in
+    # this worker's ring queue ahead of us serialize across the
+    # owner's serve pool, so each adds ~a serve time divided by the
+    # pool width.  The same formula prices the worker's shm-vs-proxy
+    # decision (plan/shmroute.py) and the autotune depth sweep.
+    return rtt_ms + owner_serve_ms * (
+        max(0, int(inflight)) / max(1, int(owner_threads))
+    )
 
 
 def predict_host_ms(

@@ -60,6 +60,7 @@ def _exported_metric_names() -> set:
     names |= {
         "replica_applied_records", "replica_apply_errors",
         "replica_tail_errors", "replica_rebuilds", "replica_staleness_s",
+        "replica_demand_idle",
     }
     for c in CLASSES:
         names |= {
@@ -669,3 +670,87 @@ def test_federation_gauges_render_as_labeled_families():
     text = reg.render()
     assert 'dss_fed_peer_state{region="b"} 2.0' in text
     assert 'dss_fed_mirror_lag_s{region="b"} 1.5' in text
+
+
+def test_grafana_and_rules_cover_shm_front():
+    """The shared-memory serving front must stay observable: dashboard
+    panels over ring saturation / slots in flight / served rate and the
+    per-worker counter families (cache hits, ring trips, proxy
+    fallbacks), plus a DssShmRingSaturated alert on sustained
+    saturation or ring-full fallback rate (a saturated ring silently
+    degrades every search to the loopback-proxy cost)."""
+    dash = json.load(
+        open(os.path.join(ROOT, "deploy/grafana/dss-dashboard.json"))
+    )
+    exprs = [
+        t["expr"]
+        for p in dash["panels"]
+        for t in p.get("targets", [])
+    ]
+    for needed in (
+        "dss_shm_saturation",
+        "dss_shm_slots_in_flight",
+        "dss_shm_served_total",
+        "dss_shm_ring_full_total",
+        "dss_shm_reclaimed_total",
+        "dss_shm_worker_cache_hits",
+        "dss_shm_worker_enqueued",
+        "dss_shm_worker_proxy_fallbacks",
+        "dss_shm_workers",
+    ):
+        assert any(needed in e for e in exprs), needed
+    rules = yaml.safe_load(
+        open(os.path.join(ROOT, "deploy/prometheus/rules.yaml"))
+    )
+    alerts = {
+        r.get("alert"): r["expr"]
+        for g in rules["groups"]
+        for r in g["rules"]
+    }
+    assert "DssShmRingSaturated" in alerts
+    assert "dss_shm_saturation" in alerts["DssShmRingSaturated"]
+    assert "DssShmWorkerDead" in alerts
+    assert "dss_shm_reclaimed_total" in alerts["DssShmWorkerDead"]
+
+
+def test_shm_worker_gauges_render_as_process_family():
+    """dss_shm_worker_* are keyed gauge families labeled by the
+    worker's process id — and because every multi-process registry
+    already stamps a constant process="..." label on its own series,
+    the renderer must NOT duplicate it on these families (a duplicate
+    label name invalidates the whole scrape)."""
+    from dss_tpu.api.app import _GAUGE_VEC_LABELS
+    from dss_tpu.obs.metrics import MetricsRegistry
+
+    assert _GAUGE_VEC_LABELS["dss_shm_worker_cache_hits"] == "process"
+    reg = MetricsRegistry(proc="leader:123")
+    reg.set_gauge_vec(
+        "dss_shm_worker_cache_hits", "process", {"worker-0": 7.0}
+    )
+    reg.set_gauge("dss_shm_saturation", 0.25)
+    text = reg.render()
+    assert (
+        'dss_shm_worker_cache_hits{process="worker-0"} 7.0' in text
+    )
+    # the leader's own gauges keep the constant label
+    assert 'dss_shm_saturation{process="leader:123"} 0.25' in text
+    for line in text.splitlines():
+        assert line.count('process="') <= 1, line
+
+
+def test_multi_process_scrape_coherence_labels():
+    """Under SO_REUSEPORT consecutive scrapes land on different
+    processes: every series a worker or leader exports must carry the
+    distinguishing `process` label so the series never appear to
+    reset across scrapes (obs/metrics.py)."""
+    from dss_tpu.obs.metrics import MetricsRegistry
+
+    reg = MetricsRegistry(proc="worker-1:999")
+    reg.observe_request("GET", "/v1/dss/subscriptions", 200, 0.01)
+    reg.set_gauge("follower_applied_seq", 42)
+    reg.set_counter("dss_shm_worker_plan_shm_total", 3)
+    text = reg.render()
+    for line in text.splitlines():
+        if line.startswith("#"):
+            continue
+        assert 'process="worker-1:999"' in line, line

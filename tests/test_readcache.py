@@ -239,6 +239,38 @@ def test_repeat_poll_hits_and_is_bit_identical(store):
     assert c1["hits"] == c0["hits"] + 1
 
 
+def test_advancing_earliest_hits_one_line_and_refilters(store):
+    """The poll shape: the RID service clamps `earliest` to the wall
+    clock, so every repeat poll arrives with a DIFFERENT earliest.
+    That timestamp must not be part of the cache key (it would make
+    each poll a unique, never-hit line) — its only effect, the
+    t_end >= earliest expiry filter, is re-applied at lookup."""
+    cells = _cells(700, 732)
+    store.rid.insert_isa(_isa(81, cells))
+    store.rid.insert_isa(
+        _isa(82, cells, end=T0 + timedelta(minutes=10))
+    )
+    e0 = T0 + timedelta(minutes=5)
+    fresh = _ids(store.rid.search_isas(cells, e0, None))
+    assert fresh == [_uuid(81), _uuid(82)]
+    c0 = store.cache.stats()
+    # the clock advanced: the next poll's clamped earliest is later —
+    # same line hits, and the shorter ISA has expired out of it
+    e1 = T0 + timedelta(minutes=15)
+    later = _ids(store.rid.search_isas(cells, e1, None))
+    c1 = store.cache.stats()
+    assert later == [_uuid(81)]
+    assert c1["hits"] == c0["hits"] + 1
+    # an explicit `latest` bound is a DIFFERENT query window -> its
+    # own line (miss), never served from the unbounded entry
+    bounded = _ids(store.rid.search_isas(
+        cells, e1, T0 + timedelta(hours=24)
+    ))
+    c2 = store.cache.stats()
+    assert bounded == [_uuid(81)]
+    assert c2["hits"] == c1["hits"]
+
+
 def test_write_in_covering_invalidates_then_repopulates(store):
     cells = _cells(200, 232)
     store.rid.insert_isa(_isa(3, cells))

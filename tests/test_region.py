@@ -240,7 +240,9 @@ def test_rid_update_and_search_across_instances(region):
     v2 = out["service_area"]["version"]
     assert v2 != v1
 
-    # a stale token is rejected on any instance (region-current check)
+    # a stale token is rejected on any instance (region-current check);
+    # C must have tailed the create first or it 404s instead of 409ing
+    wait_until(lambda: stores[2].rid.get_isa(isa_id))
     with pytest.raises(errors.StatusError) as ei:
         services[2].update_isa(
             isa_id, v1,

@@ -411,3 +411,36 @@ def test_mesh_offload_for_oversized_stale_ok_batches(tmp_path):
     assert b[0].result == ["local"]
     assert co.mesh_offloads == 1
     co.close()
+
+
+def test_replica_demand_paced_refresh(tmp_path):
+    """The background loop's rebuild gate (_refresh_due): rebuild
+    unconditionally during the boot grace, go idle once the pace
+    window passes with no freshness probes, and resume on the next
+    fresh() consult — the mesh route's demand signal.  Pace <= 0
+    restores the historical always-rebuild loop."""
+    import time as _t
+
+    from dss_tpu.parallel.replica import ShardedOpReplica
+
+    wal = tmp_path / "dss.wal"
+    wal.touch()
+    mesh = make_mesh(8, dp=2, sp=4)
+    rep = ShardedOpReplica(mesh, wal_path=str(wal))
+    rep.demand_pace_s = 5.0
+    now = _t.monotonic()
+
+    rep._started_at = now  # inside boot grace
+    assert rep._refresh_due()
+
+    rep._started_at = now - 60.0  # grace over, no demand -> idle
+    assert not rep._refresh_due()
+    assert rep.stats()["replica_demand_idle"] == 1
+
+    rep.fresh()  # a mesh-shaped batch probed freshness -> resume
+    assert rep._refresh_due()
+    assert rep.stats()["replica_demand_idle"] == 0
+
+    rep.demand_pace_s = 0.0  # pacing disabled -> always rebuild
+    rep._demand_last = 0.0
+    assert rep._refresh_due()
