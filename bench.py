@@ -3342,6 +3342,234 @@ def _phase_slo_row(phase_name, results, mix) -> dict:
     }
 
 
+def _scrape_scalar(sess, name) -> float:
+    """One scalar gauge from this session's process (/metrics; the
+    constant process label is tolerated)."""
+    import re
+
+    base = getattr(sess, "_dss_base", None)
+    txt = sess.get(f"{base}/metrics", timeout=10).text
+    pat = re.compile(
+        rf"^{re.escape(name)}(?:\{{[^}}]*\}})?\s+([0-9.eE+-]+)$"
+    )
+    for line in txt.splitlines():
+        m = pat.match(line)
+        if m:
+            return float(m.group(1))
+    return float("nan")
+
+
+def trace_smoke_leg() -> int:
+    """`bench.py --leg trace-smoke` (CI job trace-smoke): the
+    end-to-end tracing acceptance drill over the REAL binary as
+    leader + 2 shm-front workers, in two boots.
+
+    Boot A (tracing disabled — the default): drive populate + polls
+    through the front and assert the recorder performed ZERO
+    allocations in EVERY process (dss_trace_allocs_total, counter-
+    verified — the one-branch-per-seam contract).
+
+    Boot B (DSS_TRACE_SAMPLE=0, DSS_TRACE_SLOW_MS armed, a seeded
+    DSS_FAULT_PLAN delaying every `device.dispatch`): a fresh-area
+    search rides worker -> shm ring -> owner -> dispatch, breaches the
+    slow bound, and must be TAIL-CAPTURED on the worker that served it
+    with the injected stage dominating its span tree — stitched across
+    both processes from the slot's trace words.  A repeat poll (worker
+    cache hit, fast) must NOT be captured."""
+    import json as _json
+    import uuid as _uuid
+
+    from benchmarks.bench_rid_search import _free_port, wait_for_healthy
+
+    failures = []
+
+    def check(name, ok, detail=""):
+        print(f"  {'ok ' if ok else 'FAIL'} {name} {detail}")
+        if not ok:
+            failures.append(name)
+
+    now = time.time()
+    lat, lng = 47.61, -122.33
+
+    def area_str(d=0.01):
+        return ",".join(
+            f"{a:.5f},{b:.5f}" for a, b in [
+                (lat - d, lng - d), (lat - d, lng + d),
+                (lat + d, lng + d), (lat + d, lng - d),
+            ]
+        )
+
+    search_url_tail = (
+        "/v1/dss/identification_service_areas"
+        f"?area={area_str()}&earliest_time={_shm_iso(now, 60)}"
+    )
+
+    # ---- boot A: tracing disabled, zero recorder allocations ----
+    port = _free_port()
+    base = f"http://127.0.0.1:{port}"
+    srv = _boot_scd_server(port, "tpu", extra=["--workers", "2"])
+    try:
+        wait_for_healthy(base, deadline_s=120.0)
+        sessions = _shm_sessions(base, want_workers=2)
+        w0 = sessions[sorted(
+            k for k in sessions if k.startswith("worker")
+        )[0]]
+        r = w0.put(
+            f"{base}/v1/dss/identification_service_areas/"
+            f"{_uuid.UUID(int=(31 << 64) | 1, version=4)}",
+            json=_shm_isa_body(
+                lat, lng, _shm_iso(now, 30), _shm_iso(now, 7200)
+            ),
+            timeout=30,
+        )
+        check("disabled_write_200", r.status_code == 200, r.status_code)
+        for _ in range(6):
+            r = w0.get(base + search_url_tail, timeout=30)
+            check("disabled_search_200", r.status_code == 200,
+                  r.status_code) if r.status_code != 200 else None
+        allocs = {
+            name: _scrape_scalar(s, "dss_trace_allocs_total")
+            for name, s in sessions.items()
+        }
+        check(
+            "disabled_zero_recorder_allocs",
+            all(v == 0 for v in allocs.values()), allocs,
+        )
+        started = {
+            name: _scrape_scalar(s, "dss_trace_started_total")
+            for name, s in sessions.items()
+        }
+        check(
+            "disabled_zero_traces_started",
+            all(v == 0 for v in started.values()), started,
+        )
+        for s in sessions.values():
+            s.close()
+    finally:
+        srv.terminate()
+        try:
+            srv.wait(timeout=40)
+        except Exception:  # noqa: BLE001
+            srv.kill()
+
+    # ---- boot B: tail capture of an injected-slow dispatch ----
+    delay_s = float(os.environ.get("DSS_BENCH_TRACE_DELAY_S", 0.3))
+    slow_ms = float(os.environ.get("DSS_BENCH_TRACE_SLOW_MS", 150.0))
+    plan = {"seed": 11, "events": [{
+        "site": "device.dispatch", "action": "delay",
+        "delay_s": delay_s, "count": -1,
+    }]}
+    port = _free_port()
+    base = f"http://127.0.0.1:{port}"
+    srv = _boot_scd_server(
+        port, "tpu",
+        extra=["--workers", "2", "--no_resident"],
+        env_extra={
+            "DSS_TRACE_SAMPLE": "0",
+            "DSS_TRACE_SLOW_MS": str(slow_ms),
+            "DSS_FAULT_PLAN": _json.dumps(plan),
+        },
+    )
+    try:
+        wait_for_healthy(base, deadline_s=120.0)
+        sessions = _shm_sessions(base, want_workers=2)
+        w0 = sessions[sorted(
+            k for k in sessions if k.startswith("worker")
+        )[0]]
+        r = w0.put(
+            f"{base}/v1/dss/identification_service_areas/"
+            f"{_uuid.UUID(int=(32 << 64) | 1, version=4)}",
+            json=_shm_isa_body(
+                lat, lng, _shm_iso(now, 30), _shm_iso(now, 7200)
+            ),
+            timeout=30,
+        )
+        check("write_200", r.status_code == 200, r.status_code)
+        # the slow one: fresh-area search -> worker miss -> ring ->
+        # owner -> delayed dispatch; wall time must breach slow_ms
+        t0 = time.perf_counter()
+        r = w0.get(base + search_url_tail, timeout=30)
+        slow_wall_ms = (time.perf_counter() - t0) * 1000
+        check("slow_search_200", r.status_code == 200, r.status_code)
+        check(
+            "slow_search_breaches_bound",
+            slow_wall_ms >= slow_ms,
+            f"{slow_wall_ms:.0f}ms",
+        )
+        slow_tid = r.headers.get("X-Request-Id", "")
+        # the fast one: repeat poll = worker cache hit, microseconds
+        t0 = time.perf_counter()
+        r = w0.get(base + search_url_tail, timeout=30)
+        fast_wall_ms = (time.perf_counter() - t0) * 1000
+        check("fast_poll_200", r.status_code == 200, r.status_code)
+        check(
+            "fast_poll_under_bound", fast_wall_ms < slow_ms,
+            f"{fast_wall_ms:.0f}ms",
+        )
+        fast_tid = r.headers.get("X-Request-Id", "")
+        # the worker that served both holds exactly the slow trace
+        d = w0.get(f"{base}/aux/v1/debug/traces", timeout=10).json()
+        get_traces = [
+            t for t in d["traces"]
+            if "GET /v1/dss/identification_service_areas"
+            in t["root"]["name"]
+        ]
+        check("slow_trace_captured", len(get_traces) == 1,
+              [t["root"]["name"] for t in d["traces"]])
+        check(
+            "fast_trace_not_captured",
+            all(t["trace_id"] != fast_tid for t in d["traces"]),
+        )
+        if get_traces:
+            tr = get_traces[0]
+            check("captured_as_slow", tr["kept"] == "slow", tr["kept"])
+            check(
+                "captured_id_matches_header",
+                tr["trace_id"] == slow_tid,
+                (tr["trace_id"], slow_tid),
+            )
+            spans = {}
+            stack = [tr["root"]]
+            while stack:
+                n = stack.pop()
+                spans.setdefault(n["name"], 0.0)
+                spans[n["name"]] = max(
+                    spans[n["name"]], n["duration_ms"]
+                )
+                stack.extend(n["children"])
+            # the stitched cross-process tree: ring RTT + the owner's
+            # span slots, the tentpole acceptance surface
+            for needed in ("shm.ring", "owner.queue_wait", "plan",
+                           "cache.lookup", "admission",
+                           "device.dispatch", "collect"):
+                check(f"span_{needed}", needed in spans,
+                      sorted(spans))
+            disp = spans.get("device.dispatch", 0.0)
+            check(
+                "injected_stage_dominates",
+                disp >= delay_s * 1000 * 0.8
+                and disp >= 0.5 * tr["duration_ms"],
+                f"device.dispatch={disp:.0f}ms "
+                f"root={tr['duration_ms']:.0f}ms",
+            )
+        for s in sessions.values():
+            s.close()
+    finally:
+        srv.terminate()
+        try:
+            srv.wait(timeout=40)
+        except Exception:  # noqa: BLE001
+            srv.kill()
+
+    result = {
+        "metric": "trace_smoke",
+        "ok": not failures,
+        "failures": failures,
+    }
+    print(json.dumps(result))
+    return 0 if not failures else 1
+
+
 def scenario_leg(smoke: bool = False) -> int:
     """`bench.py --leg scenario`: run the named city-scale scenarios
     (dss_tpu/scenario) end-to-end through the real HTTP stack — one
@@ -3806,6 +4034,101 @@ def _proc_cpu_seconds(pids: dict) -> dict:
     return out
 
 
+def _stage_hist_scrape(sess) -> dict:
+    """One /metrics scrape's dss_stage_duration_seconds data:
+    {(route, stage): (cumulative bucket counts by le, sum_s, count)}.
+    Works on both the per-process local family (workers=0) and the
+    merged whole-front family (shm front)."""
+    import re
+
+    base = getattr(sess, "_dss_base", None)
+    txt = sess.get(f"{base}/metrics", timeout=10).text
+    buckets: dict = {}
+    sums: dict = {}
+    cnts: dict = {}
+    pat = re.compile(
+        r"^dss_stage_duration_seconds_(bucket|sum|count)"
+        r"\{([^}]*)\}\s+([0-9.eE+-]+|\+Inf)$"
+    )
+    for line in txt.splitlines():
+        m = pat.match(line)
+        if not m:
+            continue
+        kind, labels, val = m.groups()
+        lab = dict(
+            p.split("=", 1) for p in labels.split(",") if "=" in p
+        )
+        route = lab.get("route", "").strip('"')
+        stage = lab.get("stage", "").strip('"')
+        key = (route, stage)
+        if kind == "bucket":
+            le = lab.get("le", "").strip('"')
+            if le == "+Inf":
+                continue
+            buckets.setdefault(key, {})[float(le)] = float(val)
+        elif kind == "sum":
+            sums[key] = float(val)
+        else:
+            cnts[key] = float(val)
+    out = {}
+    for key, bs in buckets.items():
+        out[key] = (
+            tuple(v for _, v in sorted(bs.items())),
+            sums.get(key, 0.0),
+            cnts.get(key, 0.0),
+        )
+    return out
+
+
+def _stage_attribution(h0: dict, h1: dict) -> dict:
+    """Per-stage latency attribution over a measurement window, from
+    two dss_stage_duration_seconds scrapes: {stage: {count, mean_ms,
+    p99_ms}} with p99 linearly interpolated inside the breached
+    bucket (routes merged — the table answers 'which STAGE owns the
+    tail').  The BENCH_r07 hand-rolled per-process CPU breakdown,
+    generalized: measured stage tails, from the serving stack itself."""
+    from dss_tpu.obs.metrics import STAGE_BUCKETS
+
+    by_stage: dict = {}
+    for key, (counts1, sum1, cnt1) in h1.items():
+        counts0, sum0, cnt0 = h0.get(
+            key, ((0.0,) * len(counts1), 0.0, 0.0)
+        )
+        stage = key[1]
+        cur = by_stage.setdefault(
+            stage, [np.zeros(len(counts1)), 0.0, 0.0]
+        )
+        cur[0] += np.asarray(counts1) - np.asarray(counts0)
+        cur[1] += sum1 - sum0
+        cur[2] += cnt1 - cnt0
+    out = {}
+    for stage, (cum, ssum, cnt) in sorted(by_stage.items()):
+        if cnt <= 0:
+            continue
+        target = 0.99 * cnt
+        p99 = None
+        prev_edge, prev_cum = 0.0, 0.0
+        for i, edge in enumerate(STAGE_BUCKETS[: len(cum)]):
+            if cum[i] >= target:
+                span_n = cum[i] - prev_cum
+                frac = (
+                    (target - prev_cum) / span_n if span_n > 0 else 1.0
+                )
+                p99 = prev_edge + frac * (edge - prev_edge)
+                break
+            prev_edge, prev_cum = edge, cum[i]
+        if p99 is None:
+            # the tail lives past the last bucket: report its edge as
+            # the floor rather than inventing a number
+            p99 = STAGE_BUCKETS[len(cum) - 1]
+        out[stage] = {
+            "count": int(cnt),
+            "mean_ms": round(1000.0 * ssum / cnt, 3),
+            "p99_ms": round(1000.0 * p99, 3),
+        }
+    return out
+
+
 def _shm_front_totals(sess) -> dict:
     """Whole-front shm counters from ONE leader scrape (the leader
     aggregates every worker's stats block)."""
@@ -3875,6 +4198,7 @@ def _http_curve_rung(workers: int, *, rates, secs, warm_s, procs,
         # refresh finish before measuring (their compiles otherwise
         # land inside the first points on a small host)
         time.sleep(float(os.environ.get("DSS_BENCH_HTTP_SETTLE", 20.0)))
+        stage_h0 = _stage_hist_scrape(lsess)
         for pt, offered in enumerate(rates):
             m0 = _co_plan_totals(base, lsess)
             shm0 = _shm_front_totals(lsess) if workers > 0 else None
@@ -3963,6 +4287,12 @@ def _http_curve_rung(workers: int, *, rates, secs, warm_s, procs,
                     shm0, _shm_front_totals(lsess)
                 )
             rows.append(row)
+        # per-stage p99 attribution over the whole sweep, from the
+        # dss_stage_duration_seconds histograms (whole-front merged
+        # under the shm front; leader-local at workers=0)
+        stage_attribution = _stage_attribution(
+            stage_h0, _stage_hist_scrape(lsess)
+        )
         # bulk drain burst: fire `conc` concurrent district-wide
         # stale-ok searches so oversized coalesced batches form — the
         # reachability probe for the hostchunk/device/mesh bulk routes
@@ -4040,6 +4370,10 @@ def _http_curve_rung(workers: int, *, rates, secs, warm_s, procs,
         "drain_burst": drain_burst,
         "sustained_qps": sustained,
         "low_load_p50_ms": low_load_p50,
+        # which STAGE owns the p99 at this rung: measured stage tails
+        # from the serving stack's own histograms, not a hand-rolled
+        # breakdown (stage names in obs/metrics.STAGE_NAMES)
+        "stage_attribution": stage_attribution,
     }
 
 
@@ -4183,7 +4517,8 @@ def main():
                  "resident-smoke", "poll", "cache-smoke", "skew",
                  "skew-smoke", "autotune", "autotune-smoke",
                  "chaos", "chaos-smoke", "scenario", "scenario-smoke",
-                 "http-curve", "federation", "shm-smoke"],
+                 "http-curve", "federation", "shm-smoke",
+                 "trace-smoke"],
         default="north-star",
         help="'north-star': the headline SCD conflict-qps benchmark "
         "(default); 'workers': multi-worker HTTP serving scaling smoke "
@@ -4233,7 +4568,12 @@ def main():
         "worker cache hits + exact write invalidation, read-your-"
         "writes on a worker session, SIGKILL-one-worker with zero "
         "5xx from survivors + slot reclaim + HEALTHY ladder, clean "
-        "SIGTERM with searches in flight)",
+        "SIGTERM with searches in flight); 'trace-smoke': the "
+        "end-to-end tracing drill (leader + 2 shm workers: tracing "
+        "disabled performs zero recorder allocations in every "
+        "process, then a fault-injected delay at device.dispatch is "
+        "tail-captured as ONE stitched worker->owner trace with the "
+        "injected stage dominating its span tree)",
     )
     args = ap.parse_args()
     if args.leg == "workers":
@@ -4269,6 +4609,8 @@ def main():
         return federation_leg()
     if args.leg == "shm-smoke":
         return shm_smoke_leg()
+    if args.leg == "trace-smoke":
+        return trace_smoke_leg()
 
     n_entities = int(os.environ.get("DSS_BENCH_ENTITIES", 1_000_000))
     n_cells = int(os.environ.get("DSS_BENCH_CELLS", 200_000))

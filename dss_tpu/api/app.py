@@ -59,6 +59,7 @@ RID_SCOPES = {
     _RID + "SearchSubscriptions": require_all_scopes(RID_READ),
     _AUX + "ValidateOauth": require_all_scopes(RID_WRITE),
     _AUX + "DebugProfile": require_all_scopes(RID_WRITE),
+    _AUX + "DebugTraces": require_all_scopes(RID_WRITE),
     # cross-region federation peer surface: any read scope may query;
     # sync ships full state, so it demands a read scope too
     _AUX + "FederationQuery": require_any_scope(
@@ -120,28 +121,74 @@ async def error_middleware(request, handler):
         return _error_response(errors.internal(str(e)))
 
 
-def make_trace_middleware():
+def make_trace_middleware(verbose: bool = True):
     """Per-request tracing (the reference's --trace-requests analog,
-    pkg/logging/http.go:36-55, upgraded): assigns/propagates an
-    X-Request-Id, collects per-stage timings (auth_ms, service_ms) that
-    the access log emits, and returns the id on the response so USS
-    operators can correlate DSS logs with their own."""
+    pkg/logging/http.go:36-55, upgraded twice): assigns/propagates an
+    X-Request-Id AND a W3C traceparent — the trace id IS the request
+    id — opens the request's root span when the trace subsystem is
+    active (obs/trace.py: head-sampled, tail-captured past
+    DSS_TRACE_SLOW_MS), and returns both headers on every response,
+    errors included, so one id greps across every process log of the
+    front.  `verbose` additionally emits the X-Dss-Stages breakdown
+    header (--trace_requests)."""
     import uuid as _uuid
+
+    from dss_tpu.obs import trace as _trace
+
+    def _root_name(request) -> str:
+        resource = (
+            request.match_info.route.resource
+            if request.match_info is not None
+            else None
+        )
+        route = (
+            resource.canonical if resource is not None else "(unmatched)"
+        )
+        return f"http {request.method} {route}"
 
     @web.middleware
     async def trace_middleware(request, handler):
-        rid = request.headers.get("X-Request-Id") or _uuid.uuid4().hex[:16]
-        request["dss_trace"] = {"request_id": rid}
+        ctx = _trace.new_trace(
+            request.headers.get("traceparent"),
+            request.headers.get("X-Request-Id"),
+        )
+        # a caller-SUPPLIED id is echoed verbatim (USS operators
+        # correlate by exact match of their own id); only minted ids
+        # are the trace id itself.  A supplied id still maps onto the
+        # trace deterministically (trace_id_from_request_id), and the
+        # traceparent header carries the canonical trace id either way.
+        rid = request.headers.get("X-Request-Id") or (
+            ctx.trace_id if ctx is not None else _uuid.uuid4().hex[:16]
+        )
+        request["dss_trace"] = {"request_id": rid, "ctx": ctx}
+        t0 = time.perf_counter()
+        status = 500
         try:
             resp = await handler(request)
+            status = resp.status
         except web.HTTPException as e:
             # error responses are the ones operators most need to
             # correlate — tag them too
+            status = e.status
             e.headers["X-Request-Id"] = rid
+            if ctx is not None:
+                e.headers["traceparent"] = _trace.format_traceparent(
+                    ctx.trace_id, ctx.root_span_id, ctx.sampled
+                )
             raise
+        finally:
+            _trace.finish_root(
+                ctx, _root_name(request),
+                (time.perf_counter() - t0) * 1000.0,
+                status=status,
+            )
         resp.headers["X-Request-Id"] = rid
+        if ctx is not None:
+            resp.headers["traceparent"] = _trace.format_traceparent(
+                ctx.trace_id, ctx.root_span_id, ctx.sampled
+            )
         stages = request.get("dss_stages")
-        if stages:
+        if verbose and stages:
             # machine-readable per-stage breakdown for callers
             # (benchmarks, USS operators correlating latency)
             resp.headers["X-Dss-Stages"] = ";".join(
@@ -150,6 +197,19 @@ def make_trace_middleware():
         return resp
 
     return trace_middleware
+
+
+def _trace_handle(request):
+    """The request's root-span trace handle (or None): what _call
+    installs on the executor thread so service-layer spans parent
+    under this request."""
+    from dss_tpu.obs import trace as _trace
+
+    tr = request.get("dss_trace") if request is not None else None
+    ctx = tr.get("ctx") if tr else None
+    if ctx is None or not ctx.recording:
+        return None
+    return _trace.SpanHandle(ctx, ctx.root_span_id)
 
 
 def make_timeout_middleware(timeout_s: float):
@@ -222,12 +282,14 @@ async def _call(fn, *args, request=None):
     from dss_tpu.dar import deadline as _deadline
     from dss_tpu.dar import readcache as _readcache
     from dss_tpu.obs import stages as _stages
+    from dss_tpu.obs import trace as _trace
     from dss_tpu.region import federation as _fed
 
     loop = asyncio.get_running_loop()
     sink = None if request is None else request.get("dss_stages")
     route_dl = None if request is None else request.get("dss_deadline")
     lag_bound = _request_lag_bound(request)
+    th = _trace_handle(request)
     t0 = time.perf_counter()
 
     def run():
@@ -238,7 +300,12 @@ async def _call(fn, *args, request=None):
         _fed.set_lag_bound(lag_bound)
         _fed.take_fed_note()  # clear any stale note on this thread
         try:
-            return fn(*args)
+            # trace handoff to the executor thread: a "service" span
+            # under the request root; everything the service layer
+            # opens (covering/store/serialize stages, cache lookups,
+            # coalescer batch spans) parents under it
+            with _trace.use(th), _trace.span("service"):
+                return fn(*args)
         finally:
             # the store's search path left its freshness note on THIS
             # thread (readcache thread-local); hand it to the handler
@@ -354,6 +421,10 @@ WORKER_LOCAL_ROUTES = {
     ("GET", "/healthy"),
     ("GET", "/metrics"),
     ("GET", "/status"),
+    # the trace flight recorder is PER PROCESS by design: the worker
+    # serving this connection answers with its own recorder (the
+    # stitched ring trace lives worker-side), never proxied
+    ("GET", "/aux/v1/debug/traces"),
     ("GET", "/aux/v1/validate_oauth"),
     ("GET", "/v1/dss/identification_service_areas"),
     ("GET", "/v1/dss/subscriptions"),
@@ -418,11 +489,26 @@ def make_worker_proxy_middleware(leader_url: str, follower=None,
         sess = await _get_session()
         body = await request.read()
         t0 = time.perf_counter()
+        t0_w = time.time_ns()
         headers = {
             k: v
             for k, v in request.headers.items()
             if k.lower() not in _PROXY_SKIP_HEADERS
         }
+        # propagate THIS hop's trace identity instead of minting a
+        # fresh id leader-side: the worker's trace middleware already
+        # resolved/minted the id, and the loopback hop must carry it
+        # (one grep-able id across worker AND leader access logs)
+        from dss_tpu.obs import trace as _trace
+
+        tr = request.get("dss_trace")
+        if tr is not None:
+            headers["X-Request-Id"] = tr["request_id"]
+            ctx = tr.get("ctx")
+            if ctx is not None:
+                headers["traceparent"] = _trace.format_traceparent(
+                    ctx.trace_id, ctx.root_span_id, ctx.sampled
+                )
         try:
             async with sess.request(
                 request.method,
@@ -436,11 +522,23 @@ def make_worker_proxy_middleware(leader_url: str, follower=None,
             return _error_response(
                 errors.unavailable(f"write leader unreachable: {e}")
             )
+        proxy_ms = (time.perf_counter() - t0) * 1000.0
+        sink = request.get("dss_stages")
+        if sink is not None:
+            sink["proxy_ms"] = round(
+                sink.get("proxy_ms", 0.0) + proxy_ms, 3
+            )
+        th = _trace_handle(request)
+        if th is not None:
+            _trace.add_span(
+                th, "proxy", t0_w, proxy_ms,
+                attrs={"fallback": fell_back},
+            )
         if fell_back and costs is not None:
             # a fallback-proxied SEARCH is the exact request shape the
             # ring would have served — feed its measured round trip to
             # the worker cost model (writes/other routes would skew it)
-            costs.observe_proxy((time.perf_counter() - t0) * 1000.0)
+            costs.observe_proxy(proxy_ms)
         if (
             follower is not None
             and seq
@@ -525,10 +623,14 @@ def build_app(
     from dss_tpu.obs.logging import make_access_log_middleware
 
     middlewares = [
-        make_access_log_middleware(metrics, dump_requests=dump_requests),
+        make_access_log_middleware(
+            metrics, dump_requests=dump_requests, health_fn=health_fn
+        ),
+        # id propagation + the trace root span are ALWAYS on (near-
+        # zero cost while DSS_TRACE_* is unset); --trace_requests only
+        # adds the verbose X-Dss-Stages response header
+        make_trace_middleware(verbose=trace_requests),
     ]
-    if trace_requests:
-        middlewares.append(make_trace_middleware())
     if default_timeout_s and default_timeout_s > 0:
         middlewares.append(make_timeout_middleware(default_timeout_s))
     middlewares.append(error_middleware)
@@ -566,11 +668,13 @@ def build_app(
         from dss_tpu.dar import deadline as _deadline
         from dss_tpu.dar import readcache as _readcache
         from dss_tpu.obs import stages as _stages
+        from dss_tpu.obs import trace as _trace
         from dss_tpu.region import federation as _fed
 
         sink = request.get("dss_stages")
         before = None if sink is None else dict(sink)
         route_dl = request.get("dss_deadline")
+        th = _trace_handle(request)
         t0 = time.perf_counter()
         if sink is not None:
             _stages.set_sink(sink)
@@ -584,7 +688,8 @@ def build_app(
         _readcache.take_note()
         _fed.take_fed_note()
         try:
-            return fn(*args)
+            with _trace.use(th), _trace.span("service"):
+                return fn(*args)
         except _budget.NeedsDevice:
             if sink is not None:
                 # drop the aborted inline attempt's partial stage
@@ -620,16 +725,21 @@ def build_app(
         if authorizer is None:
             return "anonymous"
         t0 = time.perf_counter()
+        t0_w = time.time_ns()
         try:
             owner = authorizer.authorize(
                 request.headers.get("Authorization"), operation
             )
         finally:
+            auth_ms = (time.perf_counter() - t0) * 1000
             sink = request.get("dss_stages")
             if sink is not None:
-                sink["auth_ms"] = round(
-                    (time.perf_counter() - t0) * 1000, 3
-                )
+                sink["auth_ms"] = round(auth_ms, 3)
+            th = _trace_handle(request)
+            if th is not None:
+                from dss_tpu.obs import trace as _trace
+
+                _trace.add_span(th, "auth_ms", t0_w, auth_ms)
         request["dss_owner"] = owner
         return owner
 
@@ -650,6 +760,35 @@ def build_app(
         return web.json_response(await _call_r(request, status_fn))
 
     app.router.add_get("/status", status)
+
+    async def debug_traces(request):
+        """The per-process trace flight recorder as span-tree JSON:
+        kept traces (head-sampled + tail-captured slow ones), newest
+        last, plus the recorder counters.  ?trace_id= narrows to one
+        trace; ?limit=N bounds the response.  Worker-local: each
+        process of a front answers with its OWN recorder — the
+        stitched worker->owner trace lives on the worker that served
+        the request."""
+        from dss_tpu.obs import trace as _trace
+
+        auth(request, _AUX + "DebugTraces")
+        tid = request.query.get("trace_id", "")
+        if tid:
+            found = _trace.recorder().find(tid.strip().lower())
+            return web.json_response({
+                "traces": [found] if found is not None else [],
+                "stats": _trace.stats(),
+            })
+        try:
+            limit = int(request.query.get("limit", 0))
+        except ValueError:
+            raise errors.bad_request("bad limit param")
+        return web.json_response({
+            "traces": _trace.recorder().traces(limit=limit),
+            "stats": _trace.stats(),
+        })
+
+    app.router.add_get("/aux/v1/debug/traces", debug_traces)
 
     if metrics is not None:
 

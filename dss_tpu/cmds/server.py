@@ -385,6 +385,21 @@ def build_worker(args) -> web.Application:
         proc=f"worker-{args.shm_worker_index}:{os.getpid()}"
         if args.shm_region else f"worker:{os.getpid()}"
     )
+    if front is not None:
+        # per-stage histograms across the whole front: this worker's
+        # stage observations land in its shared block, and its
+        # /metrics renders the MERGED dss_stage_duration_seconds
+        # family (any process's scrape shows the front's tails)
+        from dss_tpu.parallel.shmring import (
+            StageHistWriter, shm_stage_hist,
+        )
+
+        metrics.attach_stage_writer(
+            StageHistWriter(front.region, args.shm_worker_index)
+        )
+        metrics.set_stage_agg(
+            lambda _r=front.region: shm_stage_hist(_r)
+        )
     from dss_tpu.build_info import build_info
 
     metrics.set_info("dss_build_info", build_info())
@@ -755,6 +770,16 @@ def build(args) -> web.Application:
     # main() attaches the shared-memory front to the store (workers
     # mode) after the listen sockets exist
     app["dss_store"] = store
+    app["dss_metrics"] = metrics
+    from dss_tpu.obs import trace as _trace
+
+    if _trace.enabled():
+        cfg = _trace.env_config()
+        log.info(
+            "tracing: sample=%g slow_ms=%g ring=%d "
+            "(/aux/v1/debug/traces; DSS_TRACE_* in OPERATIONS.md)",
+            cfg["sample"], cfg["slow_ms"], cfg["ring"],
+        )
 
     # park the boot heap outside GC scans once boot actually finishes:
     # after the background warmup compile (its caches are part of the
@@ -969,6 +994,15 @@ def main():
                 worker_ttl_s=float(
                     os.environ.get("DSS_SHM_WORKER_TTL_S", 5.0)
                 ),
+            )
+            # the leader's stage observations (loopback-proxied
+            # writes) land in block N; its /metrics also renders the
+            # merged whole-front stage histograms
+            app["dss_metrics"].attach_stage_writer(
+                shmring.StageHistWriter(region, args.workers)
+            )
+            app["dss_metrics"].set_stage_agg(
+                lambda _r=region: shmring.shm_stage_hist(_r)
             )
         # With the shm front attached the leader is a PURE device
         # owner: it serves the ring plus the loopback port the workers

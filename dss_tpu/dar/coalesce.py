@@ -103,6 +103,7 @@ from dss_tpu import chaos, errors
 from dss_tpu.dar import budget
 from dss_tpu.dar import deadline as _deadline
 from dss_tpu.obs import stages as _stages
+from dss_tpu.obs import trace as _trace
 from dss_tpu.ops.conflict import NO_TIME_HI, NO_TIME_LO
 from dss_tpu.plan import (
     HEADROOM_SAFETY as _PLAN_HEADROOM_SAFETY,
@@ -119,7 +120,7 @@ from dss_tpu.plan.planner import state_of as _plan_state_of
 class _Item:
     __slots__ = ("keys", "alt_lo", "alt_hi", "t_start", "t_end", "now",
                  "owner_id", "allow_stale", "deadline", "event", "result",
-                 "error", "via_mesh")
+                 "error", "via_mesh", "tctx", "tspans", "enq_ns")
 
     def __init__(self, keys, alt_lo, alt_hi, t_start, t_end, now, owner_id,
                  allow_stale=False, deadline=None):
@@ -141,6 +142,16 @@ class _Item:
         # answered by the sharded mesh replica (bounded-stale): the
         # read cache must not stamp this result as fresh
         self.via_mesh = False
+        # cross-thread span handoff (obs/trace.py): the caller's trace
+        # handle captured at admission; the pipeline threads STAMP
+        # measured (name, start_ns, dur_ms, attrs) tuples here and the
+        # caller's own thread records them after the event resolves —
+        # queue-wait, plan, dispatch, collect become parented spans
+        # without the pipeline ever touching the recorder.  All None/0
+        # when tracing is off: one branch per item.
+        self.tctx = None
+        self.tspans = None
+        self.enq_ns = 0
 
     def expired(self, now_monotonic: float) -> bool:
         return self.deadline is not None and self.deadline <= now_monotonic
@@ -674,6 +685,14 @@ class QueryCoalescer:
             keys, alt_lo, alt_hi, t_start, t_end, now, owner_id,
             allow_stale, deadline=dl,
         )
+        # trace handle captured on the caller's thread: the pipeline
+        # stamps span timings onto the item and THIS thread records
+        # them after the event resolves (cross-thread span handoff)
+        th = _trace.current()
+        t_adm_w = 0
+        if th is not None:
+            item.tctx = th
+            t_adm_w = time.time_ns()
         inline = False
         deadline = None
         with self._cond:
@@ -702,6 +721,8 @@ class QueryCoalescer:
                     # batch: bounce to the executor path instead
                     raise budget.NeedsDevice()
                 if len(self._queue) < self._max_queue:
+                    if th is not None:
+                        item.enq_ns = time.time_ns()
                     self._queue.append(item)
                     self._ensure_threads()
                     self._cond.notify_all()
@@ -722,6 +743,13 @@ class QueryCoalescer:
                         retry_after_s=self._retry_after_locked(),
                     )
                 self._cond.wait(deadline - t_mono)
+        if th is not None:
+            # the admission gate: usually microseconds, the full
+            # admission_wait under backpressure
+            _trace.add_span(
+                th, "admission", t_adm_w,
+                (time.time_ns() - t_adm_w) / 1e6,
+            )
         if inline:
             # the lone-caller shortcut must not bypass the router: an
             # idle-server fresh query whose candidates overflow the
@@ -747,6 +775,8 @@ class QueryCoalescer:
                 "coalesce_wait_ms",
                 (time.perf_counter() - t_wait) * 1000,
             )
+        if th is not None:
+            self._record_item_spans(item, th)
         if item.error is not None:
             raise item.error
         if item.via_mesh:
@@ -948,7 +978,15 @@ class QueryCoalescer:
                     self._inflight -= 1
                     self._cond.notify_all()
                 continue
+            # cross-thread tracing: when any drained item carries a
+            # trace handle, the pipeline measures its stages as
+            # (name, start, dur) tuples and stamps them onto the
+            # items at delivery — one `traced` check per batch when
+            # tracing is off
+            traced = any(it.tctx is not None for it in batch)
+            tr_spans = [] if traced else None
             t0 = time.perf_counter()
+            t0_w = time.time_ns() if traced else 0
             pq = None
             kind = "exec"
             host_route = False
@@ -961,9 +999,17 @@ class QueryCoalescer:
                     # exactly as the pre-planner mesh-eligibility
                     # check did (freshness re-verified at execution,
                     # local fallback re-plans inline)
+                    if traced:
+                        tp_w, tp0 = time.time_ns(), time.perf_counter()
                     route = self._plan_batch(batch, headroom_ms).route
+                    if traced:
+                        tr_spans.append((
+                            "plan", tp_w,
+                            (time.perf_counter() - tp0) * 1000,
+                            {"route": route},
+                        ))
                     if route == "resident":
-                        if self._enqueue_resident(batch):
+                        if self._enqueue_resident(batch, tr_spans):
                             # the resident loop owns this batch now:
                             # its feeder submits into the device
                             # stream, its collector delivers + feeds
@@ -991,6 +1037,9 @@ class QueryCoalescer:
                             keys, lo, hi, t0s, t1s, now, owners = (
                                 self._pack_args(batch)
                             )
+                            if traced:
+                                td_w = time.time_ns()
+                                td0 = time.perf_counter()
                             try:
                                 # chaos seam: the cold fused dispatch
                                 chaos.fault_point("device.dispatch")
@@ -1013,6 +1062,13 @@ class QueryCoalescer:
                             else:
                                 kind = "table"
                                 used_device = self._pq_used_device(pq)
+                                if traced:
+                                    tr_spans.append((
+                                        "device.dispatch", td_w,
+                                        (time.perf_counter() - td0)
+                                        * 1000,
+                                        {"used_device": used_device},
+                                    ))
             except BaseException as e:  # noqa: BLE001 — deliver to callers
                 self._deliver_error(batch, e)
                 with self._cond:
@@ -1022,6 +1078,8 @@ class QueryCoalescer:
                     self._cond.notify_all()
                 continue
             pack_ms = (time.perf_counter() - t0) * 1000
+            if traced:
+                tr_spans.append(("coalesce.pack", t0_w, pack_ms, None))
             if used_device or kind == "hostchunk":
                 # count the pressure BEFORE the handoff: the collect
                 # thread decrements after processing, so incrementing
@@ -1037,7 +1095,8 @@ class QueryCoalescer:
             # bounded handoff: blocks when the collect stage is
             # pipeline_depth batches behind (the double buffer)
             self._inflight_q.put(
-                (batch, kind, pq, pack_ms, host_route, used_device)
+                (batch, kind, pq, pack_ms, host_route, used_device,
+                 tr_spans)
             )
             with self._cond:
                 self._packing = False
@@ -1055,7 +1114,8 @@ class QueryCoalescer:
             handoff = self._inflight_q.get()
             if handoff is _DONE:
                 return
-            batch, kind, pq, pack_ms, host_route, used_device = handoff
+            (batch, kind, pq, pack_ms, host_route, used_device,
+             tr_spans) = handoff
             t0 = time.perf_counter()
             t1 = t0
             device_ms = 0.0
@@ -1068,9 +1128,18 @@ class QueryCoalescer:
                     pq.wait_device()
                     t1 = time.perf_counter()
                     device_ms = (t1 - t0) * 1000
-                    self._deliver_results(
-                        batch, self._table.query_many_collect(pq)
-                    )
+                    results = self._table.query_many_collect(pq)
+                    if tr_spans is not None:
+                        coll_ms = (time.perf_counter() - t1) * 1000
+                        now_w = time.time_ns()
+                        self._stamp_spans(batch, tr_spans + [
+                            ("device.wait",
+                             now_w - int((device_ms + coll_ms) * 1e6),
+                             device_ms, None),
+                            ("collect", now_w - int(coll_ms * 1e6),
+                             coll_ms, None),
+                        ])
+                    self._deliver_results(batch, results)
                 elif kind == "hostchunk":
                     # the deadline router's forced route, deferred here
                     # so it overlaps the pack of the next drain.  Run
@@ -1083,14 +1152,22 @@ class QueryCoalescer:
                     keys, lo, hi, t0s, t1s, now, owners = (
                         self._pack_args(batch)
                     )
+                    if tr_spans is not None:
+                        th_w = time.time_ns()
+                        th0 = time.perf_counter()
                     pq = self._table.query_many_submit(
                         keys, lo, hi, t0s, t1s,
                         now=now, owner_ids=owners, host_route=True,
                     )
                     observed_device = self._pq_used_device(pq)
-                    self._deliver_results(
-                        batch, self._table.query_many_collect(pq)
-                    )
+                    results = self._table.query_many_collect(pq)
+                    if tr_spans is not None:
+                        self._stamp_spans(batch, tr_spans + [
+                            ("host.scan", th_w,
+                             (time.perf_counter() - th0) * 1000,
+                             {"fallback_device": observed_device}),
+                        ])
+                    self._deliver_results(batch, results)
                 else:
                     # mesh-planned (or submit-less table): the full
                     # synchronous path, mesh-first with local fallback
@@ -1150,6 +1227,35 @@ class QueryCoalescer:
                 self._cond.notify_all()
 
     @staticmethod
+    def _record_item_spans(item: _Item, th) -> None:
+        """Record the pipeline-stamped spans through the caller's own
+        trace handle (runs on the caller's thread, after the event) —
+        plus the queue-wait span derived from enqueue -> first stamped
+        span."""
+        spans = item.tspans or ()
+        if item.enq_ns and spans:
+            first = min(s[1] for s in spans)
+            if first > item.enq_ns:
+                _trace.add_span(
+                    th, "queue_wait", item.enq_ns,
+                    (first - item.enq_ns) / 1e6,
+                )
+        for rec in spans:
+            name, start_ns, dur_ms = rec[0], rec[1], rec[2]
+            attrs = rec[3] if len(rec) > 3 else None
+            _trace.add_span(th, name, start_ns, dur_ms, attrs=attrs)
+
+    @staticmethod
+    def _stamp_spans(batch: List[_Item], spans) -> None:
+        """Attach the batch's measured span tuples to every traced
+        item (the caller threads record them — see _record_item_spans).
+        Must run BEFORE results are delivered: event.set releases the
+        caller."""
+        for it in batch:
+            if it.tctx is not None:
+                it.tspans = spans
+
+    @staticmethod
     def _deliver_error(batch: List[_Item], e: BaseException) -> None:
         for it in batch:
             if not it.event.is_set():
@@ -1169,21 +1275,24 @@ class QueryCoalescer:
                 except Exception:  # noqa: BLE001 — metrics-only path
                     pass
 
-    def _enqueue_resident(self, batch: List[_Item]) -> bool:
+    def _enqueue_resident(self, batch: List[_Item],
+                          pre_spans=None) -> bool:
         """Hand a drained batch to the resident loop's host ring.
         Non-blocking: False (ring full / loop closed) leaves the batch
         with the caller, which falls back to the cold device path —
         the pack stage never stalls behind the device stream.  The
         loop's collector delivers results AND feeds the resident cost
         key with the measured marginal (inter-completion) cost; the
-        cold-device floor is never touched by these observations."""
+        cold-device floor is never touched by these observations.
+        `pre_spans` carries pack-stage trace spans (plan) stamped onto
+        traced items together with the stream span at delivery."""
         loop = self._res_loop
         if loop is None:
             return False
         payload = self._pack_args(batch)
 
         def done(results, err, gap_ms, lat_ms, used_device,
-                 _batch=batch):
+                 _batch=batch, _pre=pre_spans):
             if err is not None:
                 if self._absorb_device_loss(err):
                     # the stream died mid-flight: re-serve on the host
@@ -1193,6 +1302,13 @@ class QueryCoalescer:
                 else:
                     self._deliver_error(_batch, err)
             else:
+                if _pre is not None:
+                    self._stamp_spans(_batch, _pre + [(
+                        "resident.stream",
+                        time.time_ns() - int(lat_ms * 1e6), lat_ms,
+                        {"gap_ms": round(gap_ms, 3),
+                         "used_device": bool(used_device)},
+                    )])
                 self._deliver_results(_batch, results)
             with self._slock:
                 self._stat_batches += 1
@@ -1271,6 +1387,7 @@ class QueryCoalescer:
                  record_plan: bool = True):
         try:
             b = len(batch)
+            traced = any(it.tctx is not None for it in batch)
             # plan the synchronous execution: resident excluded (this
             # runs on the caller's thread — a cold dispatch dressed as
             # the stream would blow the deadline the stream's latency
@@ -1278,6 +1395,8 @@ class QueryCoalescer:
             # gets the raised-cap forced scans).  record_plan=False on
             # the collect-stage path, whose batch was already planned
             # at pack time.
+            if traced:
+                tp_w, tp0 = time.time_ns(), time.perf_counter()
             plan = self._planner.plan(
                 self._shape_of(batch, inline=True),
                 self._capture_state(host_only=budget.is_host_only()),
@@ -1285,6 +1404,12 @@ class QueryCoalescer:
                 allow_resident=False,
                 record=record_plan,
             )
+            plan_span = None
+            if traced:
+                plan_span = (
+                    "plan", tp_w, (time.perf_counter() - tp0) * 1000,
+                    {"route": plan.route},
+                )
             if plan.route == "mesh" and self._mesh_fresh():
                 try:
                     # chunk to the warmed jit bucket (the replica warms
@@ -1296,9 +1421,18 @@ class QueryCoalescer:
                         keys, lo, hi, t0s, t1s, now, _ = (
                             self._pack_args(part)
                         )
+                        if traced:
+                            tm_w = time.time_ns()
+                            tm0 = time.perf_counter()
                         results = self._mesh_fn(
                             keys, lo, hi, t0s, t1s, now
                         )
+                        if traced:
+                            self._stamp_spans(part, [plan_span, (
+                                "mesh", tm_w,
+                                (time.perf_counter() - tm0) * 1000,
+                                None,
+                            )])
                         for it, res in zip(part, results):
                             it.via_mesh = True  # before event.set()
                             it.result = res
@@ -1320,6 +1454,7 @@ class QueryCoalescer:
             host_route = plan.route == "hostchunk"
             submit = getattr(self._table, "query_many_submit", None)
             t0 = time.perf_counter()
+            t0_w = time.time_ns() if traced else 0
             used_device = None
             if submit is not None:
                 # run the split halves so the chosen route is
@@ -1334,7 +1469,33 @@ class QueryCoalescer:
                         owner_ids=owners, host_route=host_route,
                     )
                     used_device = self._pq_used_device(pq)
+                    if traced:
+                        disp_ms = (time.perf_counter() - t0) * 1000
+                        tc_w, tc0 = time.time_ns(), time.perf_counter()
                     results = self._table.query_many_collect(pq)
+                    if traced:
+                        spans = [plan_span]
+                        if host_route:
+                            spans.append((
+                                "host.scan", t0_w,
+                                disp_ms
+                                + (time.perf_counter() - tc0) * 1000,
+                                None,
+                            ))
+                        else:
+                            # the dispatch seam (incl. any injected
+                            # device.dispatch fault delay) and the
+                            # wait+decode, split like the pipeline's
+                            spans.append((
+                                "device.dispatch", t0_w, disp_ms,
+                                {"used_device": bool(used_device)},
+                            ))
+                            spans.append((
+                                "collect", tc_w,
+                                (time.perf_counter() - tc0) * 1000,
+                                None,
+                            ))
+                        self._stamp_spans(batch, spans)
                 except BaseException as e:
                     if not self._absorb_device_loss(e):
                         raise
@@ -1351,6 +1512,11 @@ class QueryCoalescer:
                     keys, lo, hi, t0s, t1s, now=now, owner_ids=owners,
                     host_route=host_route,
                 )
+                if traced:
+                    self._stamp_spans(batch, [plan_span, (
+                        "host.scan", t0_w,
+                        (time.perf_counter() - t0) * 1000, None,
+                    )])
             if used_device is not None:
                 total_ms = (time.perf_counter() - t0) * 1000
                 with self._slock:

@@ -28,6 +28,7 @@ import contextlib
 import copy
 import dataclasses
 import threading
+import time
 from datetime import datetime, timedelta, timezone
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -37,6 +38,7 @@ from dss_tpu import chaos, errors
 from dss_tpu.clock import Clock, to_nanos
 from dss_tpu.dar import codec
 from dss_tpu.dar import readcache as rcache
+from dss_tpu.obs import trace
 from dss_tpu.dar.index import MemorySpatialIndex, TpuSpatialIndex
 from dss_tpu.dar.store import RIDStore, SCDStore
 from dss_tpu.dar.wal import WriteAheadLog
@@ -187,12 +189,22 @@ class _CachedSearchMixin:
             ids = run()
             rcache.note_last_search_meshed(rcache.take_mesh_served())
             return ids
+        th = trace.current()
+        t_cl_w = t_cl0 = 0
+        if th is not None:
+            t_cl_w, t_cl0 = time.time_ns(), time.perf_counter()
         epoch = self._epoch_fn()
         fence = clock_fence(cells)
         key = (cls, owner_id, qkey, cells.tobytes())
         ids = cache.lookup(
             cls, key, fence, epoch, int(now_ns), allow_stale
         )
+        if th is not None:
+            trace.add_span(
+                th, "cache.lookup", t_cl_w,
+                (time.perf_counter() - t_cl0) * 1000,
+                attrs={"cls": cls, "hit": ids is not None},
+            )
         if ids is not None:
             rcache.note_search(cls, epoch, fence[2], True)
             rcache.note_last_search_meshed(False)
@@ -1779,6 +1791,10 @@ class DSSStore:
             out.update(self._shm_owner.stats())
         else:
             out.update(_shmmod.empty_stats())
+        # trace recorder gauges (obs/trace.py): sampling config, kept/
+        # dropped counters, ring depth, and the allocation counter the
+        # zero-cost-when-disabled contract is asserted against
+        out.update(trace.stats())
         if self.region is not None:
             out.update(self.region.stats())
         return out

@@ -53,6 +53,8 @@ from dss_tpu.dar import budget as _budget
 from dss_tpu.dar import readcache as rcache
 from dss_tpu.geo import s2cell
 from dss_tpu.geo.covering import canonical_cells
+from dss_tpu.obs import stages as _stages
+from dss_tpu.obs import trace as _trace
 from dss_tpu.parallel import shmring
 from dss_tpu.plan import shmroute
 
@@ -138,8 +140,11 @@ class ShmSearchFront:
         client = self.client
         dar_keys = s2cell.cell_to_dar_key(cells)
         fence = epoch = key = None
+        th = _trace.current()
         use_cache = cacheable and self.cache.enabled
         if use_cache:
+            if th is not None:
+                t_cl_w, t_cl0 = time.time_ns(), time.perf_counter()
             # fence-read-BEFORE-enqueue: a write landing between this
             # read and the owner's query can only age the entry
             fence = self.fence_view.fence(cls, dar_keys)
@@ -148,6 +153,13 @@ class ShmSearchFront:
             ids = self.cache.lookup(
                 cls, key, fence, epoch, int(now_ns), allow_stale
             )
+            if th is not None:
+                _trace.add_span(
+                    th, "cache.lookup", t_cl_w,
+                    (time.perf_counter() - t_cl0) * 1000,
+                    attrs={"cls": cls, "hit": ids is not None,
+                           "proc": "worker"},
+                )
             if ids is not None:
                 client.stat_add(shmring.WS_CACHE_HITS)
                 rcache.note_search(cls, epoch, fence[2], True)
@@ -180,6 +192,7 @@ class ShmSearchFront:
         client.stat_add(shmring.WS_PLAN_SHM)
 
         t0 = time.perf_counter()
+        t0_w = time.time_ns() if th is not None else 0
         try:
             resp = client.call(
                 cls=cls, cells=cells, alt_lo=alt_lo, alt_hi=alt_hi,
@@ -187,6 +200,15 @@ class ShmSearchFront:
                 allow_stale=allow_stale,
                 deadline_s=None if headroom is None
                 else headroom / 1000.0,
+                # the trace id + record bit ride the slot's reserved
+                # words; the owner then returns its span slots
+                # (stitched below).  The bit is set whenever THIS
+                # request is recording — head-sampled OR armed for
+                # DSS_TRACE_SLOW_MS tail capture, where the keep
+                # decision is retroactive and the owner cannot know in
+                # advance whether its timings will be needed
+                trace_id=None if th is None else th.ctx.trace_id,
+                trace_sampled=th is not None,
             )
         except (shmring.RingFull, shmring.RingOversize,
                 shmring.RingTimeout, chaos.FaultError) as e:
@@ -206,13 +228,39 @@ class ShmSearchFront:
         if resp.status != shmring.ST_OK:
             client.stat_add(shmring.WS_PROXY_FALLBACKS)
             raise ShmFallback(f"status-{resp.status}")
-        self.costs.observe_shm((time.perf_counter() - t0) * 1000.0)
+        rtt_ms = (time.perf_counter() - t0) * 1000.0
+        self.costs.observe_shm(rtt_ms)
+        _stages.mark("shm_ring_ms", rtt_ms, span=False)
+        if th is not None:
+            # ONE stitched trace across the process boundary: the ring
+            # round trip is a span, and the owner's span-slot
+            # durations (obs/trace.OWNER_SLOTS, carried back in the
+            # response's reserved words) become its children
+            ring_sid = _trace.add_span(
+                th, "shm.ring", t0_w, rtt_ms,
+                attrs={"cls": cls, "worker": client.worker},
+            )
+            if resp.trace_ns and ring_sid is not None:
+                off_ns = t0_w
+                for idx, ns in enumerate(resp.trace_ns):
+                    if ns <= 0:
+                        continue
+                    _trace.add_span(
+                        th, _trace.OWNER_SLOTS[idx], off_ns,
+                        ns / 1e6, parent=ring_sid,
+                        attrs={"proc": "owner"},
+                    )
         client.stat_add(shmring.WS_SERVED)
         if resp.wal_seq:
             # replica catchup: assemble records at least as new as the
             # answer (bounded — a timeout proceeds with the replica's
             # bounded staleness, same contract as the write proxy)
+            t_cu_w, t_cu0 = time.time_ns(), time.perf_counter()
             self.follower.wait_for(int(resp.wal_seq), self.catchup_s)
+            cu_ms = (time.perf_counter() - t_cu0) * 1000.0
+            _stages.mark("catchup_ms", cu_ms, span=False)
+            if th is not None:
+                _trace.add_span(th, "replica.catchup", t_cu_w, cu_ms)
         if use_cache and not resp.mesh_served:
             # a bounded-stale mesh answer must not be stamped fresh
             # behind the fence (the fence cannot see the replica's

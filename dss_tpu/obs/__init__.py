@@ -1,11 +1,15 @@
-"""Observability: structured logging + RED metrics.
+"""Observability: structured logging + RED metrics + tracing.
 
 The reference uses zap JSON logs with gRPC interceptors
 (pkg/logging) and deploys Prometheus/Grafana but exposes no app-level
 metrics (build/deploy/grpc-backend.libsonnet:6-9 — an inventory gap
-SURVEY.md §5 calls out).  Here both are first-class: JSON logs with a
-request middleware and proto-dump analog, and per-route RED metrics
-served in Prometheus text format at /metrics.
+SURVEY.md §5 calls out).  Here all three pillars are first-class:
+JSON logs with a request middleware and proto-dump analog, per-route
+RED metrics + per-stage duration histograms served in Prometheus
+text format at /metrics, and end-to-end distributed tracing
+(obs/trace.py: W3C propagation at every process boundary, head
+sampling + tail capture of SLO breaches, a bounded per-process
+flight recorder at /aux/v1/debug/traces).
 """
 
 from dss_tpu.obs.logging import configure_logging, get_logger

@@ -75,13 +75,18 @@ def log_fields(logger: logging.Logger, level: int, msg: str, **fields):
     logger.log(level, msg, extra={"fields": fields})
 
 
-def make_access_log_middleware(metrics=None, dump_requests: bool = False):
+def make_access_log_middleware(metrics=None, dump_requests: bool = False,
+                               health_fn=None,
+                               logger_name: str = "dss.access"):
     """aiohttp middleware: one JSON access-log line per request with
-    method/path/status/duration/owner, optional request/response body
-    dump (--dump_requests analog), and RED metric recording."""
+    method/path/status/duration/owner, the trace id (`trace=` — the
+    same id every hop of the front logs, so grep-by-trace crosses
+    process logs), the active degraded-mode tag when `health_fn`
+    reports one, optional request/response body dump (--dump_requests
+    analog), and RED metric recording."""
     from aiohttp import web
 
-    logger = get_logger("dss.access")
+    logger = get_logger(logger_name)
 
     @web.middleware
     async def access_log(request, handler):
@@ -133,8 +138,25 @@ def make_access_log_middleware(metrics=None, dump_requests: bool = False):
                     fields["owner"] = owner
                 fields.update(stages)
                 tr = request.get("dss_trace")
+                if tr is None:
+                    # no trace middleware on this app (region log
+                    # server): the propagated header is still the id
+                    rid = request.headers.get("X-Request-Id")
+                    tr = {"request_id": rid} if rid else None
                 if tr is not None:
                     fields["request_id"] = tr["request_id"]
+                    ctx = tr.get("ctx")
+                    fields["trace"] = (
+                        ctx.trace_id if ctx is not None
+                        else tr["request_id"]
+                    )
+                if health_fn is not None:
+                    try:
+                        mode = health_fn()
+                    except Exception:  # noqa: BLE001 — tag best-effort
+                        mode = None
+                    if mode and mode != "healthy":
+                        fields["mode"] = mode
                 if body is not None:
                     fields["request_body"] = body[:4096]
                 log_fields(logger, logging.INFO, "request", **fields)

@@ -37,6 +37,50 @@ BUCKETS = (
     5.0, 10.0,
 )
 
+# dss_stage_duration_seconds{stage,route} histogram buckets: finer at
+# the microsecond end than the request histogram — cache hits and
+# host scans live there, and the per-stage p99 attribution table
+# (bench.py http-curve) interpolates inside these
+STAGE_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0,
+)
+
+# bounded stage-label cardinality: sink keys outside this set collapse
+# to "other" (a service adding a new stage name cannot mint unbounded
+# series; add it here AND — for the shm whole-front blocks — keep
+# parallel/shmring.STAGE_SLOTS in lockstep)
+STAGE_NAMES = (
+    "auth_ms", "covering_ms", "store_ms", "serialize_ms", "service_ms",
+    "coalesce_wait_ms", "shm_ring_ms", "proxy_ms", "catchup_ms",
+    "other",
+)
+_STAGE_SET = frozenset(STAGE_NAMES)
+
+# bounded route-class cardinality for the fixed-layout shm stage
+# blocks (the per-process /metrics keeps full route templates; the
+# whole-front aggregate collapses to these three)
+ROUTE_CLASSES = ("search", "write", "other")
+
+
+def stage_name(stage: str) -> str:
+    return stage if stage in _STAGE_SET else "other"
+
+
+def route_class(route: str) -> str:
+    """Collapse a templatized route onto the fixed-cardinality class
+    set the shm stage-histogram blocks are laid out over.  Routes
+    arrive as aiohttp canonical patterns ("/v1/dss/.../{id}") from the
+    access log, or as route_template output (":id") from raw paths —
+    both placeholder spellings mark the per-entity class."""
+    if "query" in route:
+        return "search"
+    if "{" in route or ":id" in route or ":version" in route:
+        return "write"
+    if route.startswith("/v1/dss/"):
+        return "search"
+    return "other"
+
 
 def route_template(path: str) -> str:
     parts = path.split("/")
@@ -85,6 +129,19 @@ class MetricsRegistry:
         self._infos: Dict[str, Dict[str, str]] = {}
         self._stage_sum: Dict[Tuple[str, str], float] = {}
         self._stage_cnt: Dict[Tuple[str, str], int] = {}
+        # dss_stage_duration_seconds{stage,route}: (route, stage) ->
+        # [bucket counts..., sum_s, count]
+        self._shist: Dict[Tuple[str, str], list] = {}
+        # optional shm mirror (parallel/shmring.StageHistWriter): each
+        # observation also lands in this process's shared block so ANY
+        # process of the front can render the whole front's histograms
+        self._stage_writer = None
+        # optional whole-front aggregate provider: when set, render()
+        # emits dss_stage_duration_seconds from it (merged across the
+        # shm blocks, no process label — every process of the front
+        # then exports the SAME coherent family, the dss_shm_worker_*
+        # pattern) instead of the local-only histograms
+        self._stage_agg = None
 
     def observe_request(
         self, method: str, path: str, status: int, duration_s: float
@@ -106,11 +163,39 @@ class MetricsRegistry:
 
     def observe_stage(self, route: str, stage: str, duration_s: float) -> None:
         """Per-stage serving-time accounting (parse/auth/covering/
-        store/serialize) so the p50 breakdown is measured, not guessed."""
+        store/serialize) so the p50 breakdown is measured, not guessed.
+        Feeds both the legacy dss_request_stage_seconds summary and the
+        dss_stage_duration_seconds{stage,route} histogram — tail
+        percentiles per stage, which a sum/count pair cannot give."""
+        rt = route_template(route)
         with self._lock:
-            k = (route_template(route), stage)
+            k = (rt, stage)
             self._stage_sum[k] = self._stage_sum.get(k, 0.0) + duration_s
             self._stage_cnt[k] = self._stage_cnt.get(k, 0) + 1
+            hk = (rt, stage_name(stage))
+            row = self._shist.get(hk)
+            if row is None:
+                row = self._shist[hk] = [0] * (len(STAGE_BUCKETS) + 2)
+            for i, b in enumerate(STAGE_BUCKETS):
+                if duration_s <= b:
+                    row[i] += 1
+            row[-2] += duration_s
+            row[-1] += 1
+        if self._stage_writer is not None:
+            # outside the lock: the shm block is single-writer per
+            # process and numpy increments are cheap
+            self._stage_writer.observe(rt, stage, duration_s)
+
+    def attach_stage_writer(self, writer) -> None:
+        """Mirror every stage observation into this process's shared
+        stage-histogram block (parallel/shmring.StageHistWriter)."""
+        self._stage_writer = writer
+
+    def set_stage_agg(self, provider) -> None:
+        """provider() -> {(route, stage): (bucket_counts, sum_s, cnt)}
+        merged across the whole front; replaces the local histograms in
+        the exposition (see __init__ note)."""
+        self._stage_agg = provider
 
     def set_gauge(self, name: str, value: float) -> None:
         with self._lock:
@@ -200,6 +285,50 @@ class MetricsRegistry:
                     f"dss_request_duration_seconds_count{{{l}}} "
                     f"{self._hist_cnt[hk]}"
                 )
+            agg = None
+            if self._stage_agg is not None:
+                try:
+                    agg = self._stage_agg()
+                except Exception:  # noqa: BLE001 — scrape must survive
+                    agg = None
+            shist = (
+                agg if agg is not None
+                else {
+                    k: (tuple(row[:-2]), row[-2], row[-1])
+                    for k, row in self._shist.items()
+                }
+            )
+            if shist:
+                lines.append(
+                    "# TYPE dss_stage_duration_seconds histogram"
+                )
+                for rk in sorted(shist):
+                    r, st = rk
+                    counts, ssum, scnt = shist[rk]
+                    base = (
+                        f'route="{_esc_label(r)}",'
+                        f'stage="{_esc_label(st)}"'
+                    )
+                    # whole-front aggregates carry NO process label:
+                    # every process exports the same merged family
+                    l = base if agg is not None else lab(base)
+                    for i, b in enumerate(STAGE_BUCKETS):
+                        lines.append(
+                            f"dss_stage_duration_seconds_bucket{{{l},"
+                            f'le="{b}"}} {counts[i]}'
+                        )
+                    lines.append(
+                        f"dss_stage_duration_seconds_bucket{{{l},"
+                        f'le="+Inf"}} {scnt}'
+                    )
+                    lines.append(
+                        f"dss_stage_duration_seconds_sum{{{l}}} "
+                        f"{ssum:.6f}"
+                    )
+                    lines.append(
+                        f"dss_stage_duration_seconds_count{{{l}}} "
+                        f"{scnt}"
+                    )
             if self._stage_cnt:
                 lines.append("# TYPE dss_request_stage_seconds summary")
                 for k in sorted(self._stage_cnt):

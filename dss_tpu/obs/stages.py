@@ -16,6 +16,8 @@ import threading
 import time
 from contextlib import contextmanager
 
+from dss_tpu.obs import trace
+
 _tls = threading.local()
 
 
@@ -28,28 +30,45 @@ def get_sink():
     return getattr(_tls, "sink", None)
 
 
-def mark(name: str, duration_ms: float) -> None:
+def mark(name: str, duration_ms: float, span: bool = True) -> None:
     """Record an externally-measured duration into the current sink
     (no-op without one).  For callers that cannot bracket the timed
     region with `stage` — e.g. the coalescer recording how long an
-    item waited for its micro-batch.  Repeated marks accumulate."""
+    item waited for its micro-batch.  Repeated marks accumulate.
+    When a trace is recording on this thread the mark also lands as a
+    span (start back-dated by the duration); span=False skips that for
+    callers that record a richer span of their own for the same
+    region (the shm ring round trip)."""
     sink = getattr(_tls, "sink", None)
     if sink is None:
         return
     sink[name] = round(sink.get(name, 0.0) + duration_ms, 3)
+    if not span:
+        return
+    h = trace.current()
+    if h is not None:
+        trace.add_span(
+            h, name, time.time_ns() - int(duration_ms * 1e6),
+            duration_ms,
+        )
 
 
 @contextmanager
 def stage(name: str):
     """Time a block into the current sink (no-op without a sink).
-    Repeated stages accumulate."""
+    Repeated stages accumulate.  When a trace is recording on this
+    thread the block is also a span — service phases (covering/store/
+    serialize) become tree nodes for free, with real nesting (spans
+    opened inside the block parent under it)."""
     sink = getattr(_tls, "sink", None)
     if sink is None:
         yield
         return
+    sp = trace.span(name)
     t0 = time.perf_counter()
     try:
-        yield
+        with sp:
+            yield
     finally:
         sink[name] = round(
             sink.get(name, 0.0) + (time.perf_counter() - t0) * 1000, 3

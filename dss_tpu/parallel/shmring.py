@@ -71,6 +71,13 @@ import numpy as np
 
 from dss_tpu import chaos
 from dss_tpu.dar.readcache import _env_int
+from dss_tpu.obs.metrics import (
+    ROUTE_CLASSES,
+    STAGE_BUCKETS,
+    STAGE_NAMES,
+    route_class,
+    stage_name,
+)
 
 __all__ = [
     "SHM_CLASSES",
@@ -84,6 +91,8 @@ __all__ = [
     "WorkerFenceView",
     "ShmOwner",
     "ShmWorkerClient",
+    "StageHistWriter",
+    "shm_stage_hist",
     "env_knobs",
     "front_stats",
 ]
@@ -93,7 +102,8 @@ __all__ = [
 SHM_CLASSES = ("isa", "rid_sub", "op", "scd_sub", "constraint")
 
 MAGIC = 0x4453_5353_484D_5231  # "DSSSHMR1"
-VERSION = 1
+VERSION = 2  # v2: trace words in the slot header + the per-process
+#              stage-histogram segment (distributed tracing PR)
 
 HEADER_BYTES = 4096
 WSTAT_BYTES = 256  # 32 i64 counters per worker
@@ -170,16 +180,58 @@ OH_SERVE_NS = 5
 OH_DEAD_WORKERS = 6
 
 # struct layouts (little-endian, 8-aligned).  state + req_id live at
-# offsets 0/8; request and response share offset 16 onward (a slot is
+# offsets 0/8; the TRACE block at 16 carries the W3C trace id +
+# sampled bit INTO the owner (words 0-2) and the owner's span-slot
+# durations (obs/trace.OWNER_SLOTS, ns each, words 3-10) back OUT —
+# how one request becomes ONE stitched trace across the process
+# boundary without a byte of JSON on the hot path.  Request and
+# response payloads share the area past the trace block (a slot is
 # request OR response, never both).
+_TRACE_OFF = 16
+_TRACE_REQ = struct.Struct("<QQQ")  # tid_hi, tid_lo, flags
+_TRACE_RESP_WORDS = 8  # one i64 duration (ns) per OWNER_SLOTS entry
+_TRACE_RESP = struct.Struct("<" + "q" * _TRACE_RESP_WORDS)
+_TRACE_RESP_OFF = _TRACE_OFF + _TRACE_REQ.size
+_TRACE_BYTES = 96  # 3 + 8 words, padded to 8-word alignment
+TRACE_F_SAMPLED = 1
+TRACE_F_PRESENT = 2
+
 _REQ_HDR = struct.Struct("<iiddqqqqii")  # cls, flags, alt_lo, alt_hi,
 #                                          t0, t1, now, deadline_ns,
 #                                          owner_len, n_cells
 _RESP_HDR = struct.Struct("<iiqqdi")  # status, n_hits, wal_seq, gen,
 #                                       retry_after_s, flags
-_PAYLOAD_OFF = 16
+_PAYLOAD_OFF = _TRACE_OFF + _TRACE_BYTES
 _REQ_FIXED = _PAYLOAD_OFF + _REQ_HDR.size
 _RESP_FIXED = _PAYLOAD_OFF + _RESP_HDR.size
+
+
+def tid_split(trace_id: str) -> Tuple[int, int]:
+    """32-hex W3C trace id -> (hi, lo) uint64 pair for the slot."""
+    v = int(trace_id, 16)
+    return (v >> 64) & ((1 << 64) - 1), v & ((1 << 64) - 1)
+
+
+def tid_join(hi: int, lo: int) -> str:
+    return format((int(hi) << 64) | int(lo), "032x")
+
+
+# -- per-process stage-histogram blocks --------------------------------------
+#
+# dss_stage_duration_seconds{stage,route} aggregated across the front:
+# each process (worker i -> block i, the leader/owner -> block
+# nworkers) scatters its stage observations into its own fixed-layout
+# block — (route class x stage x [bucket counts..., sum_ns, count])
+# int64s, single-writer like the worker stats blocks — and ANY
+# process's /metrics renders the merged family (shm_stage_hist), so
+# one scrape shows the whole front's per-stage tails no matter which
+# worker SO_REUSEPORT hands the connection to.
+
+_SHIST_ROW = len(STAGE_BUCKETS) + 2  # buckets + sum_ns + count
+_SHIST_WORDS = len(ROUTE_CLASSES) * len(STAGE_NAMES) * _SHIST_ROW
+SHIST_BLOCK_BYTES = ((_SHIST_WORDS * 8 + 4095) // 4096) * 4096
+_ROUTE_IDX = {r: i for i, r in enumerate(ROUTE_CLASSES)}
+_STAGE_IDX = {s: i for i, s in enumerate(STAGE_NAMES)}
 
 
 class RingFull(RuntimeError):
@@ -279,7 +331,8 @@ class ShmRequest:
 
     __slots__ = ("cls", "cells", "alt_lo", "alt_hi", "t0_ns", "t1_ns",
                  "now_ns", "deadline_ns", "owner", "allow_stale",
-                 "worker", "slot", "req_id")
+                 "worker", "slot", "req_id", "trace_id",
+                 "trace_sampled")
 
     def __init__(self, **kw):
         for k in self.__slots__:
@@ -290,10 +343,10 @@ class ShmResponse:
     """A decoded response slot (worker side)."""
 
     __slots__ = ("status", "ids", "t1s", "wal_seq", "gen",
-                 "retry_after_s", "flags")
+                 "retry_after_s", "flags", "trace_ns")
 
     def __init__(self, status, ids, t1s, wal_seq, gen, retry_after_s,
-                 flags=0):
+                 flags=0, trace_ns=None):
         self.status = status
         self.ids = ids
         self.t1s = t1s
@@ -301,6 +354,11 @@ class ShmResponse:
         self.gen = gen
         self.retry_after_s = retry_after_s
         self.flags = flags
+        # the owner's span-slot durations (ns per obs/trace.OWNER_SLOTS
+        # entry) — only meaningful when the request carried a sampled
+        # trace; the worker stitches them into its own trace as child
+        # spans of the ring round trip
+        self.trace_ns = trace_ns
 
     @property
     def mesh_served(self) -> bool:
@@ -324,13 +382,21 @@ class ShmRegion:
         self.nclasses = nclasses
         self._buf = memoryview(mm)
         self.wstats_off = HEADER_BYTES
-        self.fence_off = self.wstats_off + nworkers * WSTAT_BYTES
+        # stage-histogram blocks: one per worker + one for the owner
+        self.shist_off = self.wstats_off + nworkers * WSTAT_BYTES
+        shist_bytes = (nworkers + 1) * SHIST_BLOCK_BYTES
+        self.fence_off = self.shist_off + shist_bytes
         fence_bytes = nclasses * (FENCE_HDR_BYTES + fence_slots * 8)
         self.rings_off = _pad8(self.fence_off + fence_bytes)
         # numpy views over the region (shared pages, not copies)
         self._wstats = np.ndarray(
             (nworkers, WSTAT_BYTES // 8), dtype=np.int64, buffer=mm,
             offset=self.wstats_off,
+        )
+        self._shist = np.ndarray(
+            (nworkers + 1, _SHIST_WORDS), dtype=np.int64, buffer=mm,
+            offset=self.shist_off,
+            strides=(SHIST_BLOCK_BYTES, 8),
         )
         self._fence_hdrs = []
         self._fence_stamps = []
@@ -367,7 +433,10 @@ class ShmRegion:
             raise ValueError("slot_bytes must be >= 4096 and 8-aligned")
         fence_bytes = nclasses * (FENCE_HDR_BYTES + fence_slots * 8)
         total = (
-            _pad8(HEADER_BYTES + nworkers * WSTAT_BYTES + fence_bytes)
+            _pad8(
+                HEADER_BYTES + nworkers * WSTAT_BYTES
+                + (nworkers + 1) * SHIST_BLOCK_BYTES + fence_bytes
+            )
             + nworkers * depth * slot_bytes
         )
         fd = os.open(path, os.O_CREAT | os.O_RDWR | os.O_TRUNC, 0o600)
@@ -415,6 +484,7 @@ class ShmRegion:
     def close(self) -> None:
         # drop numpy views before closing the map (BufferError otherwise)
         self._wstats = None
+        self._shist = None
         self._fence_hdrs = []
         self._fence_stamps = []
         self._states = None
@@ -526,7 +596,9 @@ class ShmRegion:
                       cls_idx: int, cells: np.ndarray,
                       alt_lo, alt_hi, t0_ns, t1_ns, now_ns: int,
                       deadline_ns: int, owner: str,
-                      allow_stale: bool) -> None:
+                      allow_stale: bool,
+                      trace_id: Optional[str] = None,
+                      trace_sampled: bool = False) -> None:
         """Encode the request payload, then publish state=REQ.  Raises
         RingOversize when the covering (or owner scope) cannot fit."""
         off = self._slot_off(worker, slot)
@@ -551,6 +623,19 @@ class ShmRegion:
         if owner_b:
             flags |= F_HAS_OWNER
         mm = self._mm
+        # trace words: id + sampled bit in, owner span slots zeroed
+        # (the response fills them) — fixed words, never serialized
+        if trace_id:
+            hi, lo = tid_split(trace_id)
+            tflags = TRACE_F_PRESENT | (
+                TRACE_F_SAMPLED if trace_sampled else 0
+            )
+        else:
+            hi = lo = tflags = 0
+        _TRACE_REQ.pack_into(mm, off + _TRACE_OFF, hi, lo, tflags)
+        _TRACE_RESP.pack_into(
+            mm, off + _TRACE_RESP_OFF, *([0] * _TRACE_RESP_WORDS)
+        )
         _REQ_HDR.pack_into(
             mm, off + _PAYLOAD_OFF, cls_idx, flags,
             0.0 if alt_lo is None else float(alt_lo),
@@ -573,6 +658,7 @@ class ShmRegion:
         off = self._slot_off(worker, slot)
         mm = self._mm
         req_id = struct.unpack_from("<q", mm, off + 8)[0]
+        thi, tlo, tflags = _TRACE_REQ.unpack_from(mm, off + _TRACE_OFF)
         (cls_idx, flags, alt_lo, alt_hi, t0, t1, now_ns, deadline_ns,
          owner_len, n) = _REQ_HDR.unpack_from(mm, off + _PAYLOAD_OFF)
         p = off + _REQ_FIXED
@@ -598,18 +684,30 @@ class ShmRegion:
             owner=owner,
             allow_stale=bool(flags & F_ALLOW_STALE),
             worker=worker, slot=slot, req_id=req_id,
+            trace_id=(
+                tid_join(thi, tlo)
+                if tflags & TRACE_F_PRESENT else None
+            ),
+            trace_sampled=bool(tflags & TRACE_F_SAMPLED),
         )
 
     def write_response(self, worker: int, slot: int, *, status: int,
                        ids: Sequence[str] = (), t1s: Sequence[int] = (),
                        wal_seq: int = 0, gen: int = 0,
                        retry_after_s: float = 0.0,
-                       flags: int = 0) -> None:
+                       flags: int = 0,
+                       trace_ns: Optional[Sequence[int]] = None) -> None:
         """Encode the response over the request payload, then publish
         state=RESP.  An answer that cannot fit publishes ST_OVERFLOW
-        instead (the worker re-asks over the loopback proxy)."""
+        instead (the worker re-asks over the loopback proxy).
+        `trace_ns` carries the owner's span-slot durations (one int64
+        ns per obs/trace.OWNER_SLOTS entry) for sampled requests."""
         off = self._slot_off(worker, slot)
         mm = self._mm
+        if trace_ns is not None:
+            vec = list(trace_ns)[:_TRACE_RESP_WORDS]
+            vec += [0] * (_TRACE_RESP_WORDS - len(vec))
+            _TRACE_RESP.pack_into(mm, off + _TRACE_RESP_OFF, *vec)
         n = len(ids)
         id_blob = b""
         if n:
@@ -652,7 +750,8 @@ class ShmRegion:
             ids.append(bytes(mm[p:p + ln]).decode("utf-8"))
             p += ln
         return ShmResponse(
-            status, ids, t1s, wal_seq, gen, retry_after_s, flags
+            status, ids, t1s, wal_seq, gen, retry_after_s, flags,
+            trace_ns=_TRACE_RESP.unpack_from(mm, off + _TRACE_RESP_OFF),
         )
 
 
@@ -719,6 +818,60 @@ class WorkerFenceView:
         # still rotates on owner-side wholesale events so workers can
         # fence on it exactly like an epoch string
         return str(self._region.epoch_token)
+
+
+class StageHistWriter:
+    """One process's handle on its shared stage-histogram block
+    (worker i -> block i, the leader/owner -> block nworkers).
+    Single-writer per block; attached to the process's MetricsRegistry
+    (obs/metrics.attach_stage_writer) so every access-log stage
+    observation also lands in the shared segment."""
+
+    __slots__ = ("_row",)
+
+    def __init__(self, region: ShmRegion, proc_index: int):
+        if not 0 <= proc_index <= region.nworkers:
+            raise ValueError(
+                f"proc index {proc_index} outside region "
+                f"({region.nworkers} workers + owner)"
+            )
+        self._row = region._shist[proc_index]
+
+    def observe(self, route: str, stage: str, duration_s: float) -> None:
+        base = (
+            _ROUTE_IDX[route_class(route)] * len(STAGE_NAMES)
+            + _STAGE_IDX[stage_name(stage)]
+        ) * _SHIST_ROW
+        row = self._row
+        for i, b in enumerate(STAGE_BUCKETS):
+            if duration_s <= b:
+                row[base + i] += 1
+        row[base + _SHIST_ROW - 2] += int(duration_s * 1e9)
+        row[base + _SHIST_ROW - 1] += 1
+
+
+def shm_stage_hist(region: ShmRegion) -> dict:
+    """The whole front's dss_stage_duration_seconds data, merged
+    across every process block: {(route_class, stage): (bucket counts,
+    sum_s, count)}.  Zero-count rows are omitted so the exposition
+    stays compact."""
+    merged = np.asarray(region._shist).sum(axis=0)
+    out = {}
+    for r, rc in enumerate(ROUTE_CLASSES):
+        for s, st in enumerate(STAGE_NAMES):
+            base = (r * len(STAGE_NAMES) + s) * _SHIST_ROW
+            cnt = int(merged[base + _SHIST_ROW - 1])
+            if cnt == 0:
+                continue
+            out[(rc, st)] = (
+                tuple(
+                    int(x)
+                    for x in merged[base:base + len(STAGE_BUCKETS)]
+                ),
+                merged[base + _SHIST_ROW - 2] / 1e9,
+                cnt,
+            )
+    return out
 
 
 class ShmOwner:
@@ -825,6 +978,7 @@ class ShmOwner:
             req_idx = np.nonzero(states == REQ)[0]
             if len(req_idx):
                 claimed = []
+                t_claim = time.perf_counter_ns()
                 for flat in req_idx.tolist():
                     w, s = divmod(flat, r.depth)
                     if w in self._dead_workers:
@@ -832,7 +986,7 @@ class ShmOwner:
                         self._count(OH_RECLAIMED)
                         continue
                     r.set_slot_state(w, s, BUSY)
-                    claimed.append((w, s))
+                    claimed.append((w, s, t_claim))
                 if claimed:
                     with self._qcond:
                         self._queue.extend(claimed)
@@ -874,12 +1028,12 @@ class ShmOwner:
                     self._qcond.wait(0.1)
                 if self._stop.is_set() and not self._queue:
                     return
-                w, s = self._queue.pop(0)
+                w, s, t_claim = self._queue.pop(0)
             t0 = time.perf_counter_ns()
             status = ST_ERROR
             try:
                 req = r.read_request(w, s)
-                status = self._serve_one(req)
+                status = self._serve_one(req, queue_wait_ns=t0 - t_claim)
             except Exception:  # noqa: BLE001 — a bad slot must not kill the pool
                 self._count(OH_ERRORS)
                 try:
@@ -897,9 +1051,10 @@ class ShmOwner:
                         r._ohdr[OH_SERVED] += 1
                     r._ohdr[OH_SERVE_NS] += time.perf_counter_ns() - t0
 
-    def _serve_one(self, req: ShmRequest) -> int:
+    def _serve_one(self, req: ShmRequest, queue_wait_ns: int = 0) -> int:
         from dss_tpu import errors as _errors
         from dss_tpu.dar import deadline as _deadline
+        from dss_tpu.obs import trace as _trace
 
         r = self._region
         if req.deadline_ns and time.monotonic_ns() >= req.deadline_ns:
@@ -913,6 +1068,16 @@ class ShmOwner:
         )
         if route_dl is not None:
             _deadline.set_route_deadline(route_dl)
+        # sampled request: collect the serve path's spans (cache
+        # lookup, admission, plan, dispatch, collect — emitted by the
+        # store/coalescer seams on THIS thread) and ship them back as
+        # the fixed OWNER_SLOTS duration words, so the worker stitches
+        # one trace spanning both processes
+        tok = None
+        trace_vec = None
+        t_serve0 = time.perf_counter_ns()
+        if req.trace_id and req.trace_sampled:
+            tok = _trace.begin_collect(req.trace_id)
         try:
             out = self._serve_fn(req)
             # (ids, t1s, gen) or (ids, t1s, gen, flags): the store
@@ -937,9 +1102,20 @@ class ShmOwner:
         finally:
             if route_dl is not None:
                 _deadline.set_route_deadline(None)
+            if tok is not None:
+                trace_vec = _trace.owner_slot_vector(
+                    _trace.end_collect(tok),
+                    extra={
+                        "owner.queue_wait": queue_wait_ns / 1e6,
+                        "owner.serve": (
+                            (time.perf_counter_ns() - t_serve0) / 1e6
+                        ),
+                    },
+                )
         r.write_response(
             req.worker, req.slot, status=ST_OK, ids=ids, t1s=t1s,
             wal_seq=self._wal_seq_fn(), gen=gen, flags=flags,
+            trace_ns=trace_vec,
         )
         return ST_OK
 
@@ -1037,12 +1213,16 @@ class ShmWorkerClient:
     def call(self, *, cls: str, cells, alt_lo=None, alt_hi=None,
              t0_ns=None, t1_ns=None, now_ns: int, owner: str = None,
              allow_stale: bool = False,
-             deadline_s: float = None) -> ShmResponse:
+             deadline_s: float = None,
+             trace_id: str = None,
+             trace_sampled: bool = False) -> ShmResponse:
         """One round trip.  Raises RingFull / RingOversize /
         RingTimeout — all of which the caller maps to the loopback
         proxy fallback.  The chaos seam `shm.ring.enqueue` fires
         before the slot is touched, so an injected fault costs
-        nothing but the fallback."""
+        nothing but the fallback.  `trace_id`/`trace_sampled` ride the
+        slot's reserved trace words; a sampled request's response
+        carries the owner's span-slot durations back (trace_ns)."""
         chaos.fault_point("shm.ring.enqueue", detail=cls)
         r = self._region
         slot = self._alloc()
@@ -1060,6 +1240,7 @@ class ShmWorkerClient:
                 alt_lo=alt_lo, alt_hi=alt_hi, t0_ns=t0_ns, t1_ns=t1_ns,
                 now_ns=now_ns, deadline_ns=deadline_ns,
                 owner=owner or "", allow_stale=allow_stale,
+                trace_id=trace_id, trace_sampled=trace_sampled,
             )
             wrote = True
             self._region.stat_add(self.worker, WS_ENQUEUED)

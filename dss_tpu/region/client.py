@@ -33,6 +33,7 @@ from typing import List, Optional, Tuple
 import requests
 
 from dss_tpu import chaos
+from dss_tpu.obs import trace
 
 
 class RegionError(RuntimeError):
@@ -174,6 +175,15 @@ class RegionClient:
         attempts = max(self._max_retries, len(self._urls))
         tried: set = set()
         last = "unreachable"
+        # propagate the caller's trace across the region hop (ONE id
+        # end to end; the log server echoes it on every response,
+        # errors included) and time the hop as a span
+        tp = trace.propagation_headers()
+        if tp:
+            kw = dict(kw)
+            hdrs = dict(kw.get("headers") or {})
+            hdrs.update(tp)
+            kw["headers"] = hdrs
         for attempt in range(attempts + 1):
             url = self._urls[self._active]
             hint = None
@@ -182,10 +192,12 @@ class RegionClient:
                 # exactly like a connection failure (retried, failed
                 # over, breaker-counted); a delay models a slow link
                 chaos.fault_point("region.client.request", detail=url)
-                r = self._session.request(
-                    method, url + path, timeout=timeout or self._timeout,
-                    **kw,
-                )
+                with trace.span("region.request", path=path):
+                    r = self._session.request(
+                        method, url + path,
+                        timeout=timeout or self._timeout,
+                        **kw,
+                    )
             except (requests.RequestException, chaos.FaultError) as e:
                 last = f"{url}: {e}"
                 r = None

@@ -340,7 +340,13 @@ class HttpPeerTransport:
                  payload: Optional[dict] = None) -> dict:
         import requests
 
+        from dss_tpu.obs import trace as _trace
+
         last = "unreachable"
+        # one trace id across the federation hop: the peer's trace
+        # middleware joins this traceparent instead of minting fresh,
+        # and echoes the id on error responses
+        tp = _trace.propagation_headers()
         for url in self.urls:
             try:
                 # chaos seam: an injected partition here reads exactly
@@ -350,10 +356,14 @@ class HttpPeerTransport:
                     "region.federation.request",
                     detail=f"{self.region_id}:{url}{path}",
                 )
-                r = self._session.request(
-                    method, url + path, json=payload,
-                    timeout=self._timeout,
-                )
+                with _trace.span(
+                    "federation.request", region=self.region_id
+                ):
+                    r = self._session.request(
+                        method, url + path, json=payload,
+                        timeout=self._timeout,
+                        headers=tp or None,
+                    )
             except (requests.RequestException, chaos.FaultError) as e:
                 last = f"{url}: {e}"
                 continue

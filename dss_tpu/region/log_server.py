@@ -653,6 +653,35 @@ def build_region_app(
     # owns all appends)
     app["snapshot_lock"] = asyncio.Lock()
 
+    from dss_tpu.obs.logging import make_access_log_middleware
+
+    # access log (trace= field included: the propagated X-Request-Id
+    # IS the trace id, so grep-by-trace crosses into the region log's
+    # process log) — outermost, so auth failures are logged too
+    app.middlewares.append(
+        make_access_log_middleware(logger_name="dss.region.access")
+    )
+
+    @web.middleware
+    async def request_id_echo(request, handler):
+        """Echo the propagated request/trace id on EVERY response —
+        error responses included, which are exactly the ones an
+        operator chasing a cross-process trace needs to correlate.
+        The log server never MINTS ids: the DSS-instance hop owns the
+        trace; an id-less request (curl, probes) stays id-less."""
+        rid = request.headers.get("X-Request-Id")
+        try:
+            resp = await handler(request)
+        except web.HTTPException as e:
+            if rid:
+                e.headers["X-Request-Id"] = rid
+            raise
+        if rid:
+            resp.headers["X-Request-Id"] = rid
+        return resp
+
+    app.middlewares.append(request_id_echo)
+
     @web.middleware
     async def auth_middleware(request, handler):
         if auth_token and request.path not in ("/healthy", "/metrics"):

@@ -211,6 +211,8 @@ class RegionNode:
     async def _post(self, url: str, payload: dict):
         import aiohttp
 
+        from dss_tpu.obs import trace as _trace
+
         # chaos seam: a dropped/delayed push reads exactly like a
         # flaky replication link (the sender loop backs off and
         # retries; quorum math and promotion fencing are unaffected —
@@ -219,7 +221,14 @@ class RegionNode:
             "region.mirror.replicate", detail=url
         )
         t = aiohttp.ClientTimeout(total=self.repl_timeout_s)
-        async with self._session.post(url, json=payload, timeout=t) as r:
+        # propagate the active trace id across the replication hop
+        # (usually absent — the sender is a background loop — but a
+        # synchronous quorum push triggered under a traced request
+        # keeps its id, and the receiver echoes it either way)
+        tp = _trace.propagation_headers()
+        async with self._session.post(
+            url, json=payload, timeout=t, headers=tp or None,
+        ) as r:
             try:
                 body = await r.json()
             except Exception:

@@ -35,6 +35,7 @@ def _exported_metric_names() -> set:
         "dss_requests_total",
         "dss_request_duration_seconds",
         "dss_request_stage_seconds",
+        "dss_stage_duration_seconds",
         "dss_build_info",
     }
     store = DSSStore(storage="memory", clock=Clock())
@@ -711,6 +712,83 @@ def test_grafana_and_rules_cover_shm_front():
     assert "dss_shm_saturation" in alerts["DssShmRingSaturated"]
     assert "DssShmWorkerDead" in alerts
     assert "dss_shm_reclaimed_total" in alerts["DssShmWorkerDead"]
+
+
+def test_grafana_and_rules_cover_tracing():
+    """The distributed-tracing subsystem must stay observable: a
+    per-stage latency heatmap over the dss_stage_duration_seconds
+    histogram, a slow-trace-rate panel over the trace recorder
+    counters, plus the DssTraceRecorderSaturated warning and the
+    DssStageLatencyRegression per-stage p99 regression rule."""
+    dash = json.load(
+        open(os.path.join(ROOT, "deploy/grafana/dss-dashboard.json"))
+    )
+    exprs = [
+        t["expr"]
+        for p in dash["panels"]
+        for t in p.get("targets", [])
+    ]
+    for needed in (
+        "dss_stage_duration_seconds_bucket",
+        "dss_trace_kept_slow_total",
+        "dss_trace_kept_sampled_total",
+        "dss_trace_dropped_total",
+        "dss_trace_ring_depth",
+    ):
+        assert any(needed in e for e in exprs), needed
+    rules = yaml.safe_load(
+        open(os.path.join(ROOT, "deploy/prometheus/rules.yaml"))
+    )
+    alerts = {
+        r.get("alert"): r["expr"]
+        for g in rules["groups"]
+        for r in g["rules"]
+    }
+    assert "DssTraceRecorderSaturated" in alerts
+    assert "dss_trace_dropped_total" in alerts["DssTraceRecorderSaturated"]
+    assert "DssStageLatencyRegression" in alerts
+    assert (
+        "dss_stage_duration_seconds_bucket"
+        in alerts["DssStageLatencyRegression"]
+    )
+
+
+def test_stage_histogram_renders_as_labeled_family():
+    """dss_stage_duration_seconds is a labeled histogram family
+    ({stage,route}, bounded cardinality: stage names collapse onto the
+    STAGE_NAMES allowlist); per-process registries stamp the constant
+    process label on the local series."""
+    from dss_tpu.obs.metrics import MetricsRegistry, STAGE_BUCKETS
+
+    reg = MetricsRegistry(proc="worker-0:42")
+    reg.observe_stage(
+        "/v1/dss/identification_service_areas", "store_ms", 0.004
+    )
+    reg.observe_stage(
+        "/v1/dss/identification_service_areas", "made_up_stage_ms", 0.2
+    )
+    text = reg.render()
+    assert "# TYPE dss_stage_duration_seconds histogram" in text
+    assert (
+        'dss_stage_duration_seconds_bucket{'
+        'route="/v1/dss/identification_service_areas",'
+        f'stage="store_ms",process="worker-0:42",le="{STAGE_BUCKETS[0]}"'
+        in text or
+        'stage="store_ms"' in text
+    )
+    # unknown stage collapsed to the bounded label (the legacy
+    # summary family keeps raw names; the histogram must not)
+    hist_lines = [
+        l for l in text.splitlines()
+        if l.startswith("dss_stage_duration_seconds")
+    ]
+    assert any('stage="other"' in l for l in hist_lines)
+    assert not any('stage="made_up_stage_ms"' in l for l in hist_lines)
+    assert (
+        'dss_stage_duration_seconds_count{'
+        'route="/v1/dss/identification_service_areas",'
+        'stage="store_ms",process="worker-0:42"} 1' in text
+    )
 
 
 def test_shm_worker_gauges_render_as_process_family():
