@@ -1512,6 +1512,385 @@ def chaos_smoke_leg():
     return 0
 
 
+def _fanout_store(n_subs: int, n_uss: int, cells_per_area: int,
+                  *, storage: str = "tpu", **pipe_kw):
+    """A DSSStore with an attached PushPipeline, `n_uss` registered
+    webhooks, and `n_subs` RID subscriptions spread over the USSs, all
+    intersecting one shared metro covering.  -> (store, pipe, area,
+    delivered) where `delivered` is the thread-safe list the counting
+    transport appends (uss, body) tuples to."""
+    from datetime import datetime, timedelta, timezone
+
+    from dss_tpu.dar.dss_store import DSSStore
+    from dss_tpu.geo.s2cell import dar_key_to_cell
+    from dss_tpu.models import rid as ridm
+    from dss_tpu.push import PushPipeline
+
+    delivered: list = []
+    dlock = threading.Lock()
+
+    def transport(url, body, headers):
+        with dlock:
+            delivered.append((url, body))
+
+    store = DSSStore(storage=storage)
+    pipe = PushPipeline(
+        workers=pipe_kw.pop("workers", 4),
+        transport=pipe_kw.pop("transport", transport),
+        **pipe_kw,
+    )
+    store.attach_push(pipe)
+    for u in range(n_uss):
+        pipe.register_hook(f"uss{u:03d}", f"https://uss{u:03d}.example/notify")
+    area = dar_key_to_cell(
+        np.arange(cells_per_area, dtype=np.int64)
+    )
+    t0 = datetime.now(timezone.utc) + timedelta(minutes=5)
+    t1 = t0 + timedelta(hours=23)
+    for k in range(n_subs):
+        # a small slice of the shared covering per subscription: the
+        # one write intersects every one of them
+        lo = k % max(1, cells_per_area - 8)
+        sub = ridm.Subscription(
+            id=str(__import__("uuid").UUID(int=10_000 + k, version=4)),
+            owner=f"uss{k % n_uss:03d}",
+            url=f"https://uss{k % n_uss:03d}.example/notify",
+            cells=area[lo:lo + 8],
+            start_time=t0,
+            end_time=t1,
+            altitude_lo=0.0,
+            altitude_hi=3000.0,
+        )
+        assert store.rid.insert_subscription(sub) is not None
+    return store, pipe, area, delivered
+
+
+def fanout_push_leg():
+    """Headline push fan-out (`bench.py --leg fanout-push`): ONE write
+    matched against 10k+ subscriptions through the planner's rqmatch
+    route — the fused device kernel with the query and data roles
+    swapped — then fanned out as durable webhook deliveries by the
+    pool, off the write path.  Reports write-side match qps (bumps/s
+    through the rqmatch kernel), matched subscriber-pairs/s, and the
+    delivery-lag p50/p99 from enqueue to webhook completion.  Emits
+    FANOUT_r01.json next to this file."""
+    from datetime import datetime, timezone
+
+    n_subs = int(os.environ.get("DSS_BENCH_PUSH_SUBS", 10_240))
+    n_uss = int(os.environ.get("DSS_BENCH_PUSH_USS", 32))
+    writes = int(os.environ.get("DSS_BENCH_PUSH_WRITES", 8))
+    store, pipe, area, delivered = _fanout_store(
+        n_subs, n_uss, cells_per_area=256,
+        max_depth=(writes + 2) * n_subs + 1024,
+    )
+    try:
+        from dss_tpu.models import rid as ridm
+        from dss_tpu.runtime import freeze_boot_heap
+
+        freeze_boot_heap()
+        from datetime import timedelta
+
+        t0 = datetime.now(timezone.utc)
+        isa = ridm.IdentificationServiceArea(
+            id=str(__import__("uuid").UUID(int=1, version=4)),
+            owner="bench", url="https://uss.example/flights",
+            cells=area, start_time=t0,
+            end_time=t0 + timedelta(hours=24),
+            altitude_lo=0.0, altitude_hi=3000.0,
+        )
+        isa = store.rid.insert_isa(isa)
+        pre = store.stats()
+        # warm pass: jit/trace warm on the rqmatch route, and the
+        # headline single-write assertion — one write, 10k+ matched
+        bumped = store.rid.update_notification_idxs_in_cells(
+            area, entity=isa
+        )
+        assert len(bumped) == n_subs, (len(bumped), n_subs)
+        assert len(bumped) >= 10_000, (
+            f"fan-out below the acceptance floor: {len(bumped)}"
+        )
+        t_run = time.perf_counter()
+        for _ in range(writes):
+            out = store.rid.update_notification_idxs_in_cells(
+                area, entity=isa
+            )
+            assert len(out) == n_subs
+        match_s = time.perf_counter() - t_run
+        assert pipe.drain(timeout_s=300.0), (
+            f"delivery queue never drained: depth={pipe.log.depth()}"
+        )
+        drain_s = time.perf_counter() - t_run
+        post = store.stats()
+        rq_plans = (
+            post["dss_dar_rid_sub_co_plan_rqmatch"]
+            - pre["dss_dar_rid_sub_co_plan_rqmatch"]
+        )
+        assert rq_plans >= 1, (
+            "write-side matching never planned the rqmatch device "
+            f"route: {rq_plans}"
+        )
+        ps = pipe.stats()
+        assert ps["dss_push_dropped_total"] == 0, ps
+        assert ps["dss_push_parked_total"] == 0, ps
+        assert ps["dss_push_acked_total"] == (writes + 1) * n_subs, ps
+        assert len(delivered) == (writes + 1) * n_subs
+        lag = pipe.pool.lag_percentiles_ms()
+    finally:
+        store.close()
+    result = {
+        "metric": "fanout_push",
+        "value": round((writes * n_subs) / match_s, 1),
+        "unit": "matched_pairs_per_s",
+        "detail": {
+            "subscriptions": n_subs,
+            "uss_hooks": n_uss,
+            "timed_writes": writes,
+            "matched_per_write": n_subs,
+            "match_write_qps": round(writes / match_s, 2),
+            "matched_pairs_per_s": round((writes * n_subs) / match_s, 1),
+            "rqmatch_plans": int(rq_plans),
+            "delivered": len(delivered),
+            "delivery_lag_p50_ms": lag["p50"],
+            "delivery_lag_p99_ms": lag["p99"],
+            "drain_s": round(drain_s, 3),
+        },
+    }
+    out_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "FANOUT_r01.json"
+    )
+    with open(out_path, "w", encoding="utf-8") as fh:
+        json.dump(result, fh, indent=1)
+    print(json.dumps(result))
+    return 0
+
+
+_FANOUT_CHILD_SRC = """
+import json, sys, time
+from dss_tpu.push.deliver import DeliveryPool
+from dss_tpu.push.queue import DeliveryLog
+
+wal, sink = sys.argv[1], sys.argv[2]
+log = DeliveryLog(wal, fsync=False)
+fh = open(sink, "a", encoding="utf-8", buffering=1)
+
+def transport(url, body, headers):
+    # deliver slowly enough that the parent's SIGKILL lands mid-drain
+    fh.write(json.dumps({"nid": body["nid"]}) + chr(10))
+    fh.flush()
+    time.sleep(0.005)
+
+pool = DeliveryPool(log, workers=1, transport=transport)
+pool.start()
+print("READY", flush=True)
+while True:
+    time.sleep(0.1)
+"""
+
+
+def fanout_smoke_leg():
+    """CI push smoke (`bench.py --leg fanout-smoke`): three
+    deterministic phases.  (1) a seeded FaultPlan at push.match and
+    push.deliver — the match fault is absorbed onto the bit-identical
+    host oracle (same bumped-subscriber ids as the no-fault write) and
+    the delivery faults recover via retry with nothing parked.  (2)
+    the delivery-worker SIGKILL drill over a real child process and a
+    shared WAL: every acked notification was actually delivered
+    before the kill (zero acked loss), every unacked one is
+    redelivered after reopen, and the union covers all notifications
+    at-least-once.  (3) queue saturation flips the ladder to
+    PUSH_DEGRADED (the mildest rung) and draining under the low-water
+    mark recovers it to HEALTHY.  Exits nonzero on any miss."""
+    import signal
+    import subprocess
+    import tempfile
+
+    from dss_tpu import chaos
+
+    chaos.clear_plan()
+    chaos.registry().reset_counters()
+    detail = {}
+
+    # -- phase 1: seeded faults on the match + deliver seams ----------
+    store, pipe, area, delivered = _fanout_store(
+        n_subs=64, n_uss=8, cells_per_area=64, workers=2,
+    )
+    try:
+        oracle = sorted(
+            s.id for s in store.rid.update_notification_idxs_in_cells(
+                area
+            )
+        )
+        assert len(oracle) == 64, len(oracle)
+        assert pipe.drain(10.0)
+        base_acked = pipe.log.acked
+        chaos.install_plan(
+            {"seed": 17, "events": [
+                {"site": "push.match", "action": "error", "count": 1},
+                {"site": "push.deliver", "action": "error", "count": 2},
+            ]}
+        )
+        got = sorted(
+            s.id for s in store.rid.update_notification_idxs_in_cells(
+                area
+            )
+        )
+        assert got == oracle, (
+            "faulted match diverged from the no-fault oracle"
+        )
+        assert pipe.stage("rid_sub").absorbed >= 1, (
+            "push.match fault was not absorbed onto the host oracle"
+        )
+        assert pipe.drain(30.0), (
+            f"faulted deliveries never drained: {pipe.log.depth()}"
+        )
+        injected = chaos.registry().injected_by_site()
+        assert injected.get("push.match", 0) == 1, injected
+        assert injected.get("push.deliver", 0) == 2, injected
+        ps = pipe.stats()
+        assert ps["dss_push_parked_total"] == 0, ps
+        assert ps["dss_push_acked_total"] == base_acked + 64, ps
+        assert store.health.mode() == chaos.HEALTHY
+        detail["fault_injected"] = injected
+        detail["fault_retries"] = ps["dss_push_requeued_total"]
+    finally:
+        chaos.clear_plan()
+        store.close()
+
+    # -- phase 2: SIGKILL a delivery worker process mid-drain ---------
+    n_evt = 200
+    with tempfile.TemporaryDirectory() as td:
+        wal = os.path.join(td, "push.wal")
+        sink = os.path.join(td, "delivered.jsonl")
+        from dss_tpu.push.queue import DeliveryLog
+
+        log = DeliveryLog(wal, fsync=False)
+        log.register_hook("u1", "https://u1.example/notify")
+        for i in range(n_evt):
+            assert log.enqueue(
+                "u1", "https://u1.example/notify", {"nid": i + 1}
+            ) is not None
+        log.close()
+
+        def read_sink():
+            if not os.path.exists(sink):
+                return []
+            out = []
+            with open(sink, "r", encoding="utf-8") as fh:
+                for line in fh:
+                    try:
+                        out.append(json.loads(line)["nid"])
+                    except (ValueError, KeyError):
+                        pass  # torn tail write racing the reader
+            return out
+
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        child = subprocess.Popen(
+            [sys.executable, "-c", _FANOUT_CHILD_SRC, wal, sink],
+            env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        t_kill = time.perf_counter()
+        try:
+            while len(read_sink()) < n_evt // 4:
+                assert child.poll() is None, "child died before kill"
+                assert time.perf_counter() - t_kill < 120.0, (
+                    "child never started delivering"
+                )
+                time.sleep(0.01)
+            os.kill(child.pid, signal.SIGKILL)
+        finally:
+            if child.poll() is None:
+                child.kill()
+            child.wait(timeout=10.0)
+        before_kill = read_sink()
+        assert len(before_kill) >= n_evt // 4
+
+        # reopen the WAL: acked ⊆ delivered (zero acked loss), and
+        # everything unacked replays for redelivery
+        log2 = DeliveryLog(wal, fsync=False)
+        all_nids = set(range(1, n_evt + 1))
+        pending = set(
+            n.body["nid"] for n in log2._open.values()
+        )
+        acked = all_nids - pending
+        lost = acked - set(before_kill)
+        assert not lost, (
+            f"SIGKILL lost {len(lost)} ACKED notifications: "
+            f"{sorted(lost)[:10]}"
+        )
+        assert log2.depth() == n_evt - len(acked)
+
+        from dss_tpu.push.deliver import DeliveryPool
+
+        def transport2(url, body, headers):
+            with open(sink, "a", encoding="utf-8") as fh:
+                fh.write(json.dumps({"nid": body["nid"]}) + "\n")
+
+        pool2 = DeliveryPool(log2, workers=2, transport=transport2)
+        pool2.start()
+        t_rec = time.perf_counter()
+        while log2.depth() > 0:
+            assert time.perf_counter() - t_rec < 60.0, (
+                f"redelivery never drained: {log2.depth()}"
+            )
+            time.sleep(0.01)
+        recovery_s = time.perf_counter() - t_rec
+        pool2.close()
+        final = read_sink()
+        assert set(final) == all_nids, (
+            f"at-least-once miss: {sorted(all_nids - set(final))[:10]}"
+        )
+        assert pool2.parked == 0
+        log2.close()
+        detail.update(
+            delivered_before_kill=len(before_kill),
+            acked_before_kill=len(acked),
+            acked_lost=0,
+            redelivered=len(final) - len(before_kill),
+            redeliver_drain_s=round(recovery_s, 3),
+        )
+
+    # -- phase 3: saturation -> PUSH_DEGRADED -> drain -> HEALTHY -----
+    store, pipe, area, _ = _fanout_store(
+        n_subs=50, n_uss=1, cells_per_area=64, workers=1,
+        max_depth=50,
+    )
+    try:
+        pipe.pool.close()  # keep the queue full: no drain race
+        store.rid.update_notification_idxs_in_cells(area)
+        assert pipe.log.depth() == 50
+        assert store.health.is_active("push_degraded"), (
+            "saturated queue never flipped the ladder"
+        )
+        assert store.health.mode() == chaos.PUSH_DEGRADED
+        t_rec = time.perf_counter()
+        while pipe.log.depth() > 20:
+            n = pipe.log.take(timeout_s=1.0)
+            assert n is not None
+            pipe.log.ack(n.nid)
+        pipe._update_health()
+        assert store.health.mode() == chaos.HEALTHY, (
+            store.health.mode_name()
+        )
+        detail["ladder_recovery_s"] = round(
+            time.perf_counter() - t_rec, 3
+        )
+    finally:
+        store.close()
+
+    print(
+        json.dumps(
+            {
+                "metric": "fanout_smoke",
+                "value": 1,
+                "unit": "ok",
+                "detail": detail,
+            }
+        )
+    )
+    return 0
+
+
 def _chaos_device_lost_mid_stream() -> dict:
     """Named scenario: the resident stream loses its device with
     batches in flight.  Every admitted caller still resolves with the
@@ -4518,7 +4897,7 @@ def main():
                  "skew-smoke", "autotune", "autotune-smoke",
                  "chaos", "chaos-smoke", "scenario", "scenario-smoke",
                  "http-curve", "federation", "shm-smoke",
-                 "trace-smoke"],
+                 "trace-smoke", "fanout-push", "fanout-smoke"],
         default="north-star",
         help="'north-star': the headline SCD conflict-qps benchmark "
         "(default); 'workers': multi-worker HTTP serving scaling smoke "
@@ -4573,7 +4952,19 @@ def main():
         "disabled performs zero recorder allocations in every "
         "process, then a fault-injected delay at device.dispatch is "
         "tail-captured as ONE stitched worker->owner trace with the "
-        "injected stage dominating its span tree)",
+        "injected stage dominating its span tree); 'fanout-push': the "
+        "push-pipeline headline — one write matched against 10k+ "
+        "subscriptions through the rqmatch device kernel then fanned "
+        "out as durable webhook deliveries (match qps, matched "
+        "pairs/s, delivery-lag p50/p99; emits FANOUT_r01.json; "
+        "DSS_BENCH_PUSH_SUBS/_USS/_WRITES knobs); 'fanout-smoke': "
+        "deterministic push CI drill — seeded faults at push.match "
+        "(absorbed onto the bit-identical host oracle) and "
+        "push.deliver (retry-recovered, nothing parked), the "
+        "delivery-worker SIGKILL drill over a real child process "
+        "proving zero acked-notification loss + at-least-once "
+        "redelivery, and queue saturation flipping PUSH_DEGRADED "
+        "then recovering HEALTHY",
     )
     args = ap.parse_args()
     if args.leg == "workers":
@@ -4611,6 +5002,10 @@ def main():
         return shm_smoke_leg()
     if args.leg == "trace-smoke":
         return trace_smoke_leg()
+    if args.leg == "fanout-push":
+        return fanout_push_leg()
+    if args.leg == "fanout-smoke":
+        return fanout_smoke_leg()
 
     n_entities = int(os.environ.get("DSS_BENCH_ENTITIES", 1_000_000))
     n_cells = int(os.environ.get("DSS_BENCH_CELLS", 200_000))

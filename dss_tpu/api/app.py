@@ -68,6 +68,18 @@ RID_SCOPES = {
     _AUX + "FederationSync": require_any_scope(
         RID_READ, SCD_SC, SCD_CC, SCD_CM
     ),
+    # push-pipeline surface (dss_tpu/push): a USS manages its own
+    # webhook registration with any write scope; status is a read;
+    # ingest is the cross-region peer hop (same trust as federation)
+    _AUX + "PushPutHook": require_any_scope(
+        RID_WRITE, SCD_SC, SCD_CC, SCD_CM
+    ),
+    _AUX + "PushStatus": require_any_scope(
+        RID_READ, SCD_SC, SCD_CC, SCD_CM
+    ),
+    _AUX + "PushIngest": require_any_scope(
+        RID_READ, SCD_SC, SCD_CC, SCD_CM
+    ),
 }
 
 SCD_SCOPES = {
@@ -398,6 +410,7 @@ _GAUGE_VEC_LABELS = {
     "dss_fault_injected_total": "site",
     "dss_fed_peer_state": "region",
     "dss_fed_mirror_lag_s": "region",
+    "dss_push_breaker_state": "uss",
     # shared-memory front per-worker counters (parallel/shmring.py):
     # the leader aggregates every worker's shm stats block so ONE
     # scrape sees the whole front, keyed by the worker's process id
@@ -614,6 +627,7 @@ def build_app(
     default_timeout_s: float = 10.0,
     replica=None,  # ShardedOpReplica: multi-chip read-replica surface
     federation=None,  # FederationRouter: peer query/sync surface
+    push=None,  # PushPipeline: webhook registry + ingest surface
     trace_requests: bool = False,
     profile_dir: str = "",
     worker_proxy=None,  # read-worker mode: proxy middleware to leader
@@ -906,6 +920,67 @@ def build_app(
 
         app.router.add_post("/aux/v1/federation/query", federation_query)
         app.router.add_get("/aux/v1/federation/sync", federation_sync)
+
+    if push is not None:
+        # the push-pipeline surface (dss_tpu/push): webhook hook
+        # registry (durable in the delivery WAL), operator status, and
+        # the cross-region ingest hop federation forwards ride
+
+        async def push_put_hook(request):
+            owner = auth(request, _AUX + "PushPutHook")
+            uss = request.match_info["uss"]
+            if authorizer is not None and owner != uss:
+                raise errors.permission_denied(
+                    f"hook for {uss} may only be managed by {uss}"
+                )
+            params = await _params(request)
+            url = params.get("url", "")
+            if not url:
+                raise errors.bad_request("missing required url")
+            try:
+                hook = push.register_hook(
+                    uss, url, params.get("qos", "bulk")
+                )
+            except ValueError as e:
+                raise errors.bad_request(str(e))
+            return web.json_response({"uss": uss, **hook})
+
+        async def push_delete_hook(request):
+            owner = auth(request, _AUX + "PushPutHook")
+            uss = request.match_info["uss"]
+            if authorizer is not None and owner != uss:
+                raise errors.permission_denied(
+                    f"hook for {uss} may only be managed by {uss}"
+                )
+            return web.json_response(
+                {"uss": uss, "removed": push.unregister_hook(uss)}
+            )
+
+        async def push_get_hooks(request):
+            auth(request, _AUX + "PushStatus")
+            return web.json_response({"hooks": push.hooks()})
+
+        async def push_status(request):
+            auth(request, _AUX + "PushStatus")
+            return web.json_response(push.status())
+
+        async def push_ingest(request):
+            auth(request, _AUX + "PushIngest")
+            payload = await _params(request)
+            return web.json_response(
+                await _call_r(
+                    request,
+                    functools.partial(push.ingest_remote, payload),
+                )
+            )
+
+        app.router.add_put("/aux/v1/push/hooks/{uss}", push_put_hook)
+        app.router.add_delete(
+            "/aux/v1/push/hooks/{uss}", push_delete_hook
+        )
+        app.router.add_get("/aux/v1/push/hooks", push_get_hooks)
+        app.router.add_get("/aux/v1/push/status", push_status)
+        app.router.add_post("/aux/v1/push/ingest", push_ingest)
 
     if replica is not None:
         # the multi-chip read-replica surface (SURVEY §7 step 7): area

@@ -39,6 +39,7 @@ from dss_tpu.chaos.ladder import (  # noqa: F401
     HEALTHY,
     MESH_DEGRADED,
     MODE_NAMES,
+    PUSH_DEGRADED,
     REGION_LOG_DOWN,
     DegradationLadder,
 )

@@ -53,6 +53,8 @@ Registered sites (grep for the literal to find the seam):
   cache.populate                  dar/dss_store.py (read-cache insert)
   region.federation.request       region/federation.py (peer calls)
   region.federation.sync          region/federation.py (mirror refresh)
+  push.match                      push/match.py (reverse-query batch)
+  push.deliver                    push/deliver.py (webhook attempt)
 """
 
 from __future__ import annotations

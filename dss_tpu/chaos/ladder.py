@@ -5,12 +5,20 @@ planner knew about host_only, the mesh replica knew about degraded
 mode, the region coordinator knew about dirty state.  The ladder makes
 the store's health ONE explicit state machine:
 
-    HEALTHY (0) -> DEVICE_LOST (1) -> MESH_DEGRADED (2)
-                -> FEDERATION_DEGRADED (3) -> REGION_LOG_DOWN (4)
+    HEALTHY (0) -> PUSH_DEGRADED (1) -> DEVICE_LOST (2)
+                -> MESH_DEGRADED (3) -> FEDERATION_DEGRADED (4)
+                -> REGION_LOG_DOWN (5)
 
 driven by condition signals (enter/exit), where the MODE is the worst
 active condition.  Effects, wired in dar/dss_store.py + the planner:
 
+  PUSH_DEGRADED     the push delivery queue is saturated or every
+                    delivery breaker is open (dss_tpu/push/): writes
+                    and reads serve normally and matched notifications
+                    are still durably enqueued — only webhook fan-out
+                    is behind.  The mildest rung on purpose: losing
+                    push delivery never degrades the core serving
+                    contract, it degrades the no-polling add-on.
   DEVICE_LOST       the planner's device / resident / mesh routes are
                     inadmissible (ModelState.device_ok=False);
                     hostchunk + inline keep serving — the same
@@ -55,6 +63,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 __all__ = [
     "HEALTHY",
+    "PUSH_DEGRADED",
     "DEVICE_LOST",
     "MESH_DEGRADED",
     "FEDERATION_DEGRADED",
@@ -67,13 +76,20 @@ __all__ = [
 log = logging.getLogger("dss.chaos")
 
 HEALTHY = 0
-DEVICE_LOST = 1
-MESH_DEGRADED = 2
-FEDERATION_DEGRADED = 3
-REGION_LOG_DOWN = 4
+PUSH_DEGRADED = 1
+DEVICE_LOST = 2
+MESH_DEGRADED = 3
+FEDERATION_DEGRADED = 4
+REGION_LOG_DOWN = 5
 
-# condition name -> ladder severity (mode = max of active conditions)
+# condition name -> ladder severity (mode = max of active conditions).
+# Ordered by how much of the serving contract is lost: push fan-out
+# lag costs nothing but notification latency, a dead region log costs
+# write availability.  Compare modes via the symbolic constants — the
+# numbering shifts when a rung is inserted (PR 13 and this one both
+# did).
 CONDITIONS: Dict[str, int] = {
+    "push_degraded": PUSH_DEGRADED,
     "device_lost": DEVICE_LOST,
     "mesh_degraded": MESH_DEGRADED,
     "federation_degraded": FEDERATION_DEGRADED,
@@ -82,6 +98,7 @@ CONDITIONS: Dict[str, int] = {
 
 MODE_NAMES: Dict[int, str] = {
     HEALTHY: "healthy",
+    PUSH_DEGRADED: "push_degraded",
     DEVICE_LOST: "device_lost",
     MESH_DEGRADED: "mesh_degraded",
     FEDERATION_DEGRADED: "federation_degraded",

@@ -152,6 +152,17 @@ def make_parser() -> argparse.ArgumentParser:
         "(overrides the map's 'local' field; env DSS_FED_REGION)",
     )
     p.add_argument(
+        "--push",
+        action="store_true",
+        default=os.environ.get("DSS_PUSH", "") == "1",
+        help="enable the reverse-query push pipeline (dss_tpu/push): "
+        "writes are matched against the subscription DAR through the "
+        "planner's rqmatch route and fanned out to registered USS "
+        "webhooks through a WAL-backed durable delivery queue "
+        "(per-USS breakers/backoff, emergency-over-bulk QoS).  Env "
+        "fallback DSS_PUSH=1; DSS_PUSH_* knobs in docs/OPERATIONS.md",
+    )
+    p.add_argument(
         "--virtual_cpu_devices",
         type=int,
         default=0,
@@ -739,6 +750,20 @@ def build(args) -> web.Application:
                 dp, sp, "region log" if args.region_url else "wal",
             )
 
+    push = None
+    if args.push:
+        from dss_tpu.push import PushPipeline
+        from dss_tpu.push.pipeline import env_knobs as _push_knobs
+
+        push = PushPipeline(metrics=metrics, **_push_knobs())
+        store.attach_push(push)
+        log.info(
+            "push pipeline: %d delivery workers, queue bound %d, log "
+            "%s (DSS_PUSH_* knobs in docs/OPERATIONS.md)",
+            push.pool._workers, push.log.max_depth,
+            os.environ.get("DSS_PUSH_LOG") or "(in-memory)",
+        )
+
     def stats_fn():
         out = store.stats()
         if replica is not None:
@@ -760,6 +785,7 @@ def build(args) -> web.Application:
         default_timeout_s=args.default_timeout,
         replica=replica,
         federation=fed_router,
+        push=push,
         trace_requests=args.trace_requests,
         profile_dir=args.profile_dir,
         inline_reads=_inline_reads(args),

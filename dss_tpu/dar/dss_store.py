@@ -86,6 +86,57 @@ def _bump_sub(subs: Dict[str, object], sub_id: str):
     return bumped
 
 
+class _PushMixin:
+    """Reverse-query push wiring shared by both sub-stores
+    (dss_tpu/push/): DSSStore.attach_push hands the pipeline to the
+    unwrapped impls; the notify paths then (a) run subscriber matching
+    through the pipeline's rqmatch route instead of the read-side
+    coalescer — bit-identical by the MatchStage contract, but priced
+    and counted as write-side work — and (b) fan the bumped subscriber
+    set into the durable delivery queue after the journal record
+    lands.  Without a pipeline everything behaves exactly as before
+    push existed."""
+
+    _push = None
+
+    def set_push(self, pipeline) -> None:
+        self._push = pipeline
+
+    def _push_match_ids(self, cls, cells, *, alt_lo=None, alt_hi=None,
+                        t_start_ns=None, t_end_ns=None):
+        """The subscriber-id match for a write volume: the push
+        pipeline's MatchStage when attached (planner rqmatch route,
+        host-oracle fallback), else the index's own query path.
+        Returns ids in arbitrary order — callers sort."""
+        push = self._push
+        if push is not None and push.bound:
+            return push.match_ids(
+                cls, cells, alt_lo=alt_lo, alt_hi=alt_hi,
+                t_start_ns=t_start_ns, t_end_ns=t_end_ns,
+                now_ns=self._now_ns(),
+            )
+        return self._sub_index.query_ids(
+            cells, alt_lo=alt_lo, alt_hi=alt_hi,
+            t_start=t_start_ns, t_end=t_end_ns, now=self._now_ns(),
+        )
+
+    def _offer_push(self, trigger, entity, subs, *, removed=False,
+                    emergency=False, alt_lo=None, alt_hi=None,
+                    t_start=None, t_end=None) -> None:
+        """Hand the bumped subscriber set to the delivery pipeline —
+        post-journal, O(1) per subscriber (durable append + worker
+        wake); webhook I/O never runs on the write path."""
+        push = self._push
+        if push is None or not push.bound:
+            return
+        push.offer(
+            trigger, entity, subs, removed=removed,
+            emergency=emergency, alt_lo=alt_lo, alt_hi=alt_hi,
+            t_start_ns=None if t_start is None else to_nanos(t_start),
+            t_end_ns=None if t_end is None else to_nanos(t_end),
+        )
+
+
 class _TxnTimeMixin:
     """Per-transaction pinned 'now' (the stand-in for CRDB's txn
     timestamp): every visibility/expiry check inside one transaction
@@ -275,7 +326,7 @@ class OwnerInterner:
             return self._ids.setdefault(owner, len(self._ids))
 
 
-class RIDStoreImpl(_TxnTimeMixin, _CachedSearchMixin, RIDStore):
+class RIDStoreImpl(_PushMixin, _TxnTimeMixin, _CachedSearchMixin, RIDStore):
     def __init__(
         self, *, clock, ts_oracle, owners, lock, journal, index_factory,
         txn=None, capture_undo=False, cache=None, epoch_fn=None,
@@ -561,9 +612,14 @@ class RIDStoreImpl(_TxnTimeMixin, _CachedSearchMixin, RIDStore):
             cells, self._owners.intern(owner), now=self._now_ns()
         )
 
-    def update_notification_idxs_in_cells(self, cells):
+    def update_notification_idxs_in_cells(self, cells, *, entity=None,
+                                          removed=False):
+        """Bump + return RID subscriptions intersecting cells.  The
+        service passes the triggering ISA as `entity` so an attached
+        push pipeline can fan the bump out as deliveries; without a
+        pipeline the extra args are inert."""
         with self._txn_scope():
-            ids = self._sub_index.query_ids(cells, now=self._now_ns())
+            ids = self._push_match_ids("rid_sub", cells)
             out = []
             undo = []
             for i in sorted(ids):
@@ -581,6 +637,7 @@ class RIDStoreImpl(_TxnTimeMixin, _CachedSearchMixin, RIDStore):
                 if self._capture_undo:
                     rec["undo"] = undo
                 self._journal(rec)
+                self._offer_push("rid", entity, out, removed=removed)
             return out
 
     # -- WAL replay ----------------------------------------------------------
@@ -606,7 +663,7 @@ class RIDStoreImpl(_TxnTimeMixin, _CachedSearchMixin, RIDStore):
                 _bump_sub(self._subs, i)
 
 
-class SCDStoreImpl(_TxnTimeMixin, _CachedSearchMixin, SCDStore):
+class SCDStoreImpl(_PushMixin, _TxnTimeMixin, _CachedSearchMixin, SCDStore):
     def index_stats(self) -> dict:
         return self._op_index.stats()
 
@@ -872,12 +929,18 @@ class SCDStoreImpl(_TxnTimeMixin, _CachedSearchMixin, SCDStore):
         pass the write's altitude/time window so only subscriptions
         whose 4D volumes intersect the constraint fan out (an airport
         closure must not wake a subscriber watching a different
-        altitude band)."""
-        ids = self._sub_index.query_ids(
-            cells, alt_lo=alt_lo, alt_hi=alt_hi,
-            t_start=None if t_start is None else to_nanos(t_start),
-            t_end=None if t_end is None else to_nanos(t_end),
-            now=self._now_ns(),
+        altitude band).
+
+        With a push pipeline attached the id lookup rides the
+        planner's rqmatch route (dss_tpu/push/match.py) — the write IS
+        a reverse query — instead of the read-side coalescer; the
+        MatchStage contract keeps the id set bit-identical, so the
+        returned subscriber list (and the response built from it)
+        cannot change."""
+        ids = self._push_match_ids(
+            "scd_sub", cells, alt_lo=alt_lo, alt_hi=alt_hi,
+            t_start_ns=None if t_start is None else to_nanos(t_start),
+            t_end_ns=None if t_end is None else to_nanos(t_end),
         )
         want_constraints = trigger == "constraints"
         out = []
@@ -994,6 +1057,16 @@ class SCDStoreImpl(_TxnTimeMixin, _CachedSearchMixin, SCDStore):
                 rec["undo"] = undo
             self._journal(rec)
             subs = self._notify_subs_locked(stored.cells)
+            self._offer_push(
+                "operations", stored, subs,
+                emergency=stored.state in (
+                    scdm.OperationState.NON_CONFORMING,
+                    scdm.OperationState.CONTINGENT,
+                ),
+                alt_lo=stored.altitude_lower,
+                alt_hi=stored.altitude_upper,
+                t_start=stored.start_time, t_end=stored.end_time,
+            )
             return dataclasses.replace(stored), subs
 
     def delete_operation(self, id, owner):
@@ -1028,6 +1101,11 @@ class SCDStoreImpl(_TxnTimeMixin, _CachedSearchMixin, SCDStore):
                         {"t": "scd_sub_put", "doc": codec.scd_sub_to_doc(sub)}
                     ]
                 self._journal(gc_rec)
+            self._offer_push(
+                "operations", old, subs, removed=True,
+                alt_lo=old.altitude_lower, alt_hi=old.altitude_upper,
+                t_start=old.start_time, t_end=old.end_time,
+            )
             return dataclasses.replace(old), subs
 
     # -- Constraints ---------------------------------------------------------
@@ -1084,6 +1162,12 @@ class SCDStoreImpl(_TxnTimeMixin, _CachedSearchMixin, SCDStore):
                 alt_lo=stored.altitude_lower, alt_hi=stored.altitude_upper,
                 t_start=stored.start_time, t_end=stored.end_time,
             )
+            self._offer_push(
+                "constraints", stored, subs,
+                alt_lo=stored.altitude_lower,
+                alt_hi=stored.altitude_upper,
+                t_start=stored.start_time, t_end=stored.end_time,
+            )
             return dataclasses.replace(stored), subs
 
     def delete_constraint(self, id, owner):
@@ -1108,6 +1192,11 @@ class SCDStoreImpl(_TxnTimeMixin, _CachedSearchMixin, SCDStore):
                     {"t": "scd_cst_put", "doc": codec.constraint_to_doc(old)}
                 ]
             self._journal(rec)
+            self._offer_push(
+                "constraints", old, subs, removed=True,
+                alt_lo=old.altitude_lower, alt_hi=old.altitude_upper,
+                t_start=old.start_time, t_end=old.end_time,
+            )
             return dataclasses.replace(old), subs
 
     # -- Subscriptions -------------------------------------------------------
@@ -1399,6 +1488,10 @@ class DSSStore:
         # router; stats() exports the stable dss_fed_* key set either
         # way so dashboards never miss a series
         self.federation = None
+        # reverse-query push pipeline (push/pipeline.py): None until
+        # attach_push wires the durable delivery queue onto the write
+        # path; stats() exports the stable dss_push_* key set either way
+        self.push = None
         # shared-memory serving front (parallel/shmring.py): None
         # until attach_shm_front makes this process the device owner
         self._shm_owner = None
@@ -1679,6 +1772,23 @@ class DSSStore:
         self.scd = fedmod.FederatedSCDStore(self.scd, router)
         router.start()
 
+    def attach_push(self, pipeline) -> None:
+        """Wire the reverse-query push pipeline (push/pipeline.py)
+        onto the write path: subscription-match lookups route through
+        the pipeline's MatchStages (planner rqmatch candidate -> fused
+        device kernel, host oracle fallback — bit-identical either
+        way), matched writes fan out through the WAL-backed delivery
+        queue, and the delivery workers start.  The sub-store hooks go
+        on the UNWRAPPED impls so federated wrappers keep delegating;
+        ladder edges (PUSH_DEGRADED) ride the pipeline's own health
+        hook.  Safe under federation in either attach order."""
+        if self.push is not None:
+            raise RuntimeError("push pipeline already attached")
+        pipeline.bind_store(self)
+        getattr(self.rid, "_local", self.rid).set_push(pipeline)
+        getattr(self.scd, "_local", self.scd).set_push(pipeline)
+        self.push = pipeline
+
     def attach_mesh_replica(self, replica, min_batch: int = 64) -> None:
         """Route oversized bounded-staleness search batches from each
         entity class's coalescer to the multi-chip replica when it is
@@ -1724,6 +1834,8 @@ class DSSStore:
             use_load(self.range_load)
 
     def close(self):
+        if self.push is not None:
+            self.push.close()
         if self._shm_owner is not None:
             self._shm_owner.close()
         if self.federation is not None:
@@ -1791,6 +1903,15 @@ class DSSStore:
             out.update(self._shm_owner.stats())
         else:
             out.update(_shmmod.empty_stats())
+        # push-pipeline gauges: stable key set whether or not the
+        # pipeline is attached (dss_push_breaker_state renders as a
+        # labeled family keyed by uss)
+        from dss_tpu import push as _pushmod
+
+        if self.push is not None:
+            out.update(self.push.stats())
+        else:
+            out.update(_pushmod.empty_stats())
         # trace recorder gauges (obs/trace.py): sampling config, kept/
         # dropped counters, ring depth, and the allocation counter the
         # zero-cost-when-disabled contract is asserted against
@@ -1838,4 +1959,7 @@ class DSSStore:
                 None if self.federation is None
                 else self.federation.status()
             ),
+            # push-pipeline view: queue depth/lag, breaker states,
+            # parked count — the delivery-backlog runbook's first stop
+            "push": None if self.push is None else self.push.status(),
         }

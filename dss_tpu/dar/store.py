@@ -103,10 +103,13 @@ class RIDStore(abc.ABC):
 
     @abc.abstractmethod
     def update_notification_idxs_in_cells(
-        self, cells: np.ndarray
+        self, cells: np.ndarray, *, entity=None, removed: bool = False
     ) -> List[ridm.Subscription]:
         """Bump notification_index of all live subscriptions intersecting
-        cells; return them post-bump."""
+        cells; return them post-bump.  `entity`/`removed` describe the
+        triggering ISA for the push pipeline's fan-out (push/) — the
+        bump + returned list are unchanged whether or not they are
+        given."""
 
 
 class SCDStore(abc.ABC):

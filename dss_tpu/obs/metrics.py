@@ -53,6 +53,7 @@ STAGE_BUCKETS = (
 STAGE_NAMES = (
     "auth_ms", "covering_ms", "store_ms", "serialize_ms", "service_ms",
     "coalesce_wait_ms", "shm_ring_ms", "proxy_ms", "catchup_ms",
+    "push_match_ms", "push_deliver_ms",
     "other",
 )
 _STAGE_SET = frozenset(STAGE_NAMES)

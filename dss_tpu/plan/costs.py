@@ -18,6 +18,7 @@ __all__ = [
     "predict_host_ms",
     "predict_resident_latency_ms",
     "predict_resident_ms",
+    "predict_rqmatch_ms",
     "predict_shm_ms",
 ]
 
@@ -52,6 +53,20 @@ def predict_resident_latency_ms(
     # removes it) plus a floor of queue wait per batch ahead.  Use
     # for headroom (deadline) comparisons.
     return res_lat_ms + res_floor_ms * max(0, int(inflight)) + item_ms * n
+
+
+def predict_rqmatch_ms(
+    rq_floor_ms: float, rq_item_ms: float, n: int, inflight: int = 0
+) -> float:
+    # reverse-query matching (push/match.py): the SAME fused geometry
+    # kernel with query and data roles swapped — a batch of write-side
+    # match volumes scanned against the subscription DAR.  Same
+    # pipeline shape as a cold read dispatch, so the formula is the
+    # device one; its OWN keys because the subscription table is a
+    # different (usually far smaller) resident set than the entity
+    # tiers, and letting read-side observations price write-side
+    # matching would mis-route whichever side runs less often.
+    return rq_floor_ms * (1 + max(0, int(inflight))) + rq_item_ms * n
 
 
 def predict_shm_ms(
@@ -132,14 +147,17 @@ class CostModel:
 
     __slots__ = ("alpha", "chunk", "est_floor_ms", "est_item_ms",
                  "est_chunk_ms", "est_res_floor_ms", "est_res_lat_ms",
-                 "device_obs", "host_obs", "resident_obs",
+                 "est_rq_floor_ms", "est_rq_item_ms",
+                 "device_obs", "host_obs", "resident_obs", "rqmatch_obs",
                  "_sn", "_st", "_snn", "_snt")
 
     def __init__(self, *, floor_ms: float = 20.0, item_ms: float = 0.02,
                  chunk_ms: float = 0.3, chunk: int = 64,
                  alpha: float = 0.2,
                  res_floor_ms: Optional[float] = None,
-                 res_lat_ms: Optional[float] = None):
+                 res_lat_ms: Optional[float] = None,
+                 rq_floor_ms: Optional[float] = None,
+                 rq_item_ms: Optional[float] = None):
         self.alpha = float(alpha)
         self.chunk = max(1, int(chunk))
         self.est_floor_ms = float(floor_ms)
@@ -161,9 +179,20 @@ class CostModel:
         self.est_res_lat_ms = (
             self.est_floor_ms if res_lat_ms is None else float(res_lat_ms)
         )
+        # reverse-query (rqmatch) seeds: the same fused kernel, so the
+        # cold dispatch floor is the honest prior until write-side
+        # matching has produced its own observations; the per-item
+        # slope starts at the read slope for the same reason
+        self.est_rq_floor_ms = (
+            self.est_floor_ms if rq_floor_ms is None else float(rq_floor_ms)
+        )
+        self.est_rq_item_ms = (
+            self.est_item_ms if rq_item_ms is None else float(rq_item_ms)
+        )
         self.device_obs = 0
         self.host_obs = 0
         self.resident_obs = 0
+        self.rqmatch_obs = 0
         # EWMA moments of (n, total_ms) for the device fit, primed
         # from the seed (at a representative batch size) so the first
         # observations BLEND into the seeded estimate instead of
@@ -244,6 +273,24 @@ class CostModel:
             )
         self.resident_obs += 1
 
+    def observe_rqmatch(self, n: int, total_ms: float) -> None:
+        """Feed ONLY the rqmatch keys: the subscription table's match
+        dispatches never drag the read-side floor and vice versa (same
+        isolation argument as the resident keys).  Winsorized like the
+        other fits — one unwarmed-bucket compile on the subscription
+        DAR must not route every write's matching hostward."""
+        n = float(max(1, n))
+        total_ms = min(
+            float(total_ms), 4.0 * max(self.predict_rqmatch_ms(n), 0.05)
+        )
+        lvl = total_ms - self.est_rq_item_ms * n
+        self.est_rq_floor_ms = max(
+            0.02,
+            self.est_rq_floor_ms
+            + self.alpha * (lvl - self.est_rq_floor_ms),
+        )
+        self.rqmatch_obs += 1
+
     def predict_device_ms(self, n: int, inflight: int = 0) -> float:
         return predict_device_ms(
             self.est_floor_ms, self.est_item_ms, n, inflight
@@ -259,6 +306,11 @@ class CostModel:
         return predict_resident_latency_ms(
             self.est_res_lat_ms, self.est_res_floor_ms,
             self.est_item_ms, n, inflight,
+        )
+
+    def predict_rqmatch_ms(self, n: int, inflight: int = 0) -> float:
+        return predict_rqmatch_ms(
+            self.est_rq_floor_ms, self.est_rq_item_ms, n, inflight
         )
 
     def predict_host_ms(self, n: int, inflight_chunks: int = 0,

@@ -39,6 +39,14 @@ Routes (ROUTES):
               bounded-stale by construction; carries the shard
               boundary generation so a plan records WHICH placement
               it was made against.
+  rqmatch   — the reverse-query match route (push/match.py): a WRITE
+              is a query with the roles swapped, so a batch of
+              write-side match volumes rides the same fused geometry
+              kernel against the subscription classes' DAR.  Its own
+              cost keys (est_rq_*) because the subscription table is
+              a different resident set than the entity tiers; when
+              the device class is inadmissible the host oracle
+              (hostchunk) serves the match bit-identically.
 
 Adding a route means adding a candidate in `enumerate_candidates`, an
 arm in the `decide` policy, and a throughput arm in `route_qps` — all
@@ -71,7 +79,10 @@ __all__ = [
 # route decision can never disagree about the budget.
 HEADROOM_SAFETY = 0.5
 
-ROUTES = ("cache", "inline", "hostchunk", "device", "resident", "mesh")
+ROUTES = (
+    "cache", "inline", "hostchunk", "device", "resident", "mesh",
+    "rqmatch",
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -87,6 +98,11 @@ class ModelState:
     est_chunk_ms: float
     est_res_floor_ms: float
     est_res_lat_ms: float
+    # reverse-query (rqmatch) keys — defaulted to 0 so model states
+    # recorded before the route existed still replay; state_of always
+    # passes the live estimates
+    est_rq_floor_ms: float = 0.0
+    est_rq_item_ms: float = 0.0
     chunk: int = 64
     inflight_device: int = 0
     inflight_host_chunks: int = 0
@@ -129,6 +145,13 @@ class ModelState:
             self.inflight_host_chunks, self.inflight_device,
         )
 
+    def predict_rqmatch_ms(self, n: int) -> float:
+        # pre-route recorded states carry 0.0 rq keys: fall back to
+        # the cold-device keys they DID record (the rq seeds anyway)
+        floor = self.est_rq_floor_ms or self.est_floor_ms
+        item = self.est_rq_item_ms or self.est_item_ms
+        return _c.predict_rqmatch_ms(floor, item, n, self.inflight_device)
+
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
 
@@ -150,6 +173,11 @@ class BatchShape:
     all_stale: bool = False
     owner_scoped: bool = False
     inline: bool = False
+    # write-side reverse-query match batch (push/match.py): routes to
+    # the rqmatch candidate when the device class is admissible, else
+    # the bit-identical host oracle — never cache/mesh/resident (a
+    # match must be exact against the CURRENT subscription set)
+    rqmatch: bool = False
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -215,6 +243,17 @@ def enumerate_candidates(
     cost — `decide` and `plan_drain_cap` consume this map."""
     n = shape.n
     cand: Dict[str, Optional[float]] = {r: None for r in ROUTES}
+    if shape.rqmatch:
+        # write-side match batch: exactness pins the candidate set to
+        # the fused kernel over the live subscription DAR or the
+        # bit-identical host oracle — bounded-stale routes (cache,
+        # mesh, resident) could miss a subscription upserted since
+        # their snapshot, and a missed notification is a correctness
+        # bug, not a staleness note
+        if state.device_ok:
+            cand["rqmatch"] = state.predict_rqmatch_ms(n)
+        cand["hostchunk"] = state.predict_host_ms(n)
+        return cand
     # cache: a hit never reaches the planner (the store answers it in
     # microseconds before admission) — enumerated as the ~free
     # candidate so the plan mix is honest about what a miss costs
@@ -293,6 +332,22 @@ def decide(
             headroom_ms=headroom_ms,
         )
 
+    if shape.rqmatch:
+        # reverse-query match: device kernel when admissible — under
+        # DEVICE_LOST (or a headroom the dispatch floor cannot fit)
+        # the host oracle serves the same answer bit-identically
+        rq = cand["rqmatch"]
+        hc = cand["hostchunk"]
+        if rq is None:
+            return mk("hostchunk", hc)
+        if (
+            headroom_ms is not None
+            and rq > HEADROOM_SAFETY * headroom_ms
+            and hc is not None
+            and hc < rq
+        ):
+            return mk("hostchunk", hc)
+        return mk("rqmatch", rq)
     if allow_mesh and cand["mesh"] is not None:
         return mk("mesh", cand["mesh"], fresh="bounded_stale")
     pred_dev = cand["device"]
@@ -392,6 +447,8 @@ def state_of(cost, **pressure) -> ModelState:
         est_chunk_ms=cost.est_chunk_ms,
         est_res_floor_ms=cost.est_res_floor_ms,
         est_res_lat_ms=cost.est_res_lat_ms,
+        est_rq_floor_ms=cost.est_rq_floor_ms,
+        est_rq_item_ms=cost.est_rq_item_ms,
         chunk=cost.chunk,
         **pressure,
     )
@@ -499,6 +556,8 @@ class Planner:
                 ),
                 1e-3,
             ) * 1000.0
+        if route == "rqmatch":
+            return n / max(state.predict_rqmatch_ms(n), 1e-3) * 1000.0
         # device, mesh (one mesh chunk trip ~ one cold dispatch), and
         # anything unknown: the cold-dispatch throughput
         return n / max(
@@ -541,6 +600,9 @@ class Planner:
     def observe_resident(self, n: int, gap_ms: float,
                          lat_ms: Optional[float] = None) -> None:
         self.cost.observe_resident(n, gap_ms, lat_ms)
+
+    def observe_rqmatch(self, n: int, total_ms: float) -> None:
+        self.cost.observe_rqmatch(n, total_ms)
 
     # -- introspection ----------------------------------------------------
 

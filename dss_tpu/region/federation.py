@@ -1218,8 +1218,11 @@ class FederatedRIDStore(RIDStore):
             cells, owner
         )
 
-    def update_notification_idxs_in_cells(self, cells):
-        return self._local.update_notification_idxs_in_cells(cells)
+    def update_notification_idxs_in_cells(self, cells, *, entity=None,
+                                          removed=False):
+        return self._local.update_notification_idxs_in_cells(
+            cells, entity=entity, removed=removed
+        )
 
     # -- guarded writes ----------------------------------------------------
 

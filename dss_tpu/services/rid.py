@@ -103,7 +103,9 @@ class RIDService:
                 cells = np.union1d(
                     np.asarray(old.cells, np.uint64), np.asarray(isa.cells, np.uint64)
                 )
-            subs = self.store.update_notification_idxs_in_cells(cells)
+            subs = self.store.update_notification_idxs_in_cells(
+                cells, entity=isa
+            )
             ret = self.store.insert_isa(isa)
             if ret is None:
                 raise errors.version_mismatch("old version")
@@ -137,7 +139,9 @@ class RIDService:
                 raise errors.version_mismatch("old version")
             if old.owner != owner:
                 raise errors.permission_denied(f"ISA is owned by {old.owner}")
-            subs = self.store.update_notification_idxs_in_cells(old.cells)
+            subs = self.store.update_notification_idxs_in_cells(
+                old.cells, entity=old, removed=True
+            )
             isa = self.store.delete_isa(
                 dataclasses.replace(old, owner=owner, version=old.version)
             )

@@ -757,7 +757,7 @@ def test_store_exports_health_and_fault_gauges():
         assert fs["degraded"] == {}
 
         store.health.enter("device_lost", "injected")
-        assert store.stats()["dss_degraded_mode"] == 1.0
+        assert store.stats()["dss_degraded_mode"] == float(chaos.DEVICE_LOST)
         fs = store.freshness_status()
         assert fs["degraded_mode"] == "device_lost"
         assert fs["degraded"]["device_lost"]["reason"] == "injected"

@@ -402,9 +402,10 @@ def test_openapi_spec_covers_every_route():
         metrics=MetricsRegistry(),
         profile_dir="/tmp/profiles",
         replica=_FakeReplica(),
-        # any non-None router registers the federation peer surface
-        # (handlers consult it only at request time)
+        # any non-None router/pipeline registers the federation and
+        # push surfaces (handlers consult them only at request time)
         federation=object(),
+        push=object(),
     )
     app_ops = set()
     for route in app.router.routes():
@@ -712,6 +713,71 @@ def test_grafana_and_rules_cover_shm_front():
     assert "dss_shm_saturation" in alerts["DssShmRingSaturated"]
     assert "DssShmWorkerDead" in alerts
     assert "dss_shm_reclaimed_total" in alerts["DssShmWorkerDead"]
+
+
+def test_grafana_and_rules_cover_push():
+    """The reverse-query push pipeline must stay observable: dashboard
+    panels over queue depth / delivery lag / oldest unacked and the
+    match->enqueue->deliver flow counters (including the per-USS
+    breaker family), plus the DssPushDeliveryLagHigh warning and the
+    DssPushQueueSaturated page registered in the alert rules (a
+    saturated queue is already shedding bulk notifications and has
+    flipped the ladder to PUSH_DEGRADED)."""
+    dash = json.load(
+        open(os.path.join(ROOT, "deploy/grafana/dss-dashboard.json"))
+    )
+    exprs = [
+        t["expr"]
+        for p in dash["panels"]
+        for t in p.get("targets", [])
+    ]
+    for needed in (
+        "dss_push_queue_depth",
+        "dss_push_delivery_lag_p50_ms",
+        "dss_push_delivery_lag_p99_ms",
+        "dss_push_oldest_pending_s",
+        "dss_push_match_queries_total",
+        "dss_push_match_absorbed_total",
+        "dss_push_enqueued_total",
+        "dss_push_delivered_total",
+        "dss_push_requeued_total",
+        "dss_push_parked_total",
+        "dss_push_dropped_total",
+        "dss_push_breaker_state",
+        "dss_push_fed_forwarded_total",
+    ):
+        assert any(needed in e for e in exprs), needed
+    rules = yaml.safe_load(
+        open(os.path.join(ROOT, "deploy/prometheus/rules.yaml"))
+    )
+    alerts = {
+        r.get("alert"): r["expr"]
+        for g in rules["groups"]
+        for r in g["rules"]
+    }
+    assert "DssPushDeliveryLagHigh" in alerts
+    assert "dss_push_delivery_lag_p99_ms" in alerts["DssPushDeliveryLagHigh"]
+    assert "dss_push_oldest_pending_s" in alerts["DssPushDeliveryLagHigh"]
+    assert "DssPushQueueSaturated" in alerts
+    assert "dss_push_queue_depth" in alerts["DssPushQueueSaturated"]
+    assert "dss_push_dropped_total" in alerts["DssPushQueueSaturated"]
+
+
+def test_push_breaker_gauge_renders_as_labeled_family():
+    """dss_push_breaker_state is a keyed gauge family labeled by the
+    subscriber USS (the delivery-side analog of dss_breaker_state's
+    `remote`), routed through the metrics handler's per-metric label
+    map."""
+    from dss_tpu.api.app import _GAUGE_VEC_LABELS
+    from dss_tpu.obs.metrics import MetricsRegistry
+
+    assert _GAUGE_VEC_LABELS["dss_push_breaker_state"] == "uss"
+    reg = MetricsRegistry()
+    reg.set_gauge_vec(
+        "dss_push_breaker_state", "uss", {"uss1": 2.0}
+    )
+    text = reg.render()
+    assert 'dss_push_breaker_state{uss="uss1"} 2.0' in text
 
 
 def test_grafana_and_rules_cover_tracing():

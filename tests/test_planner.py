@@ -340,6 +340,9 @@ def test_every_route_reachable_by_some_plan():
     reached["inline"] = pl.plan(
         BatchShape(n=1, inline=True), st(), 1000.0
     ).route
+    reached["rqmatch"] = pl.plan(
+        BatchShape(n=32, rqmatch=True), st(), None
+    ).route
     pl.note("cache")
     assert all(reached[r] == r for r in reached), reached
     stats = pl.stats()

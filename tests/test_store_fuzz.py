@@ -186,6 +186,17 @@ def test_backends_agree_under_random_ops(seed, monkeypatch):
         "tpu_flat": flat,
     }
     others = [n for n in stores if n != "memory"]
+    # the push pipeline rides the TIERED tpu store: its notify-path
+    # matching now routes through the planner's rqmatch MatchStage
+    # (fused kernel over the live subscription DAR) while the memory
+    # oracle keeps the linear scan — so every subscriber-set equality
+    # assertion below pins "no missed match, no duplicate match" under
+    # interleaved subscription writes, folds, and major compactions
+    from dss_tpu.push import PushPipeline
+
+    push = PushPipeline(workers=1, transport=lambda *a: None)
+    tiered.attach_push(push)
+    push.register_hook("u1", "http://u1.example/notify")
     rid = {n: RIDService(s.rid, s.clock) for n, s in stores.items()}
     scd = {n: SCDService(s.scd, s.clock) for n, s in stores.items()}
     max_tiers = 0
@@ -542,6 +553,17 @@ def test_backends_agree_under_random_ops(seed, monkeypatch):
         )
     for n in ("memory_nocache", "tpu_flat"):
         assert stores[n].cache.stats()["hits"] == 0
+    # the push differential must actually have exercised the rqmatch
+    # route (ISA writes occur in every seed's sequence), fan-out must
+    # have enqueued without shedding, and the no-op transport must
+    # have acked everything the writes produced
+    tpu_stats = stores["tpu"].stats()
+    assert tpu_stats["dss_dar_rid_sub_co_plan_rqmatch"] > 0
+    assert push.drain(10.0)
+    pst = push.stats()
+    assert pst["dss_push_enqueued_total"] > 0
+    assert pst["dss_push_dropped_total"] == 0
+    assert pst["dss_push_acked_total"] == pst["dss_push_enqueued_total"]
     for s in stores.values():
         s.close()
 
