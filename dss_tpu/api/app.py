@@ -411,6 +411,11 @@ _GAUGE_VEC_LABELS = {
     "dss_fed_peer_state": "region",
     "dss_fed_mirror_lag_s": "region",
     "dss_push_breaker_state": "uss",
+    # self-tuning knob families (dss_tpu/tune): active vs proposed
+    # values per hot-swappable knob — the Grafana tuner panel diffs
+    # the two series
+    "dss_tune_knob_active": "knob",
+    "dss_tune_knob_proposed": "knob",
     # shared-memory front per-worker counters (parallel/shmring.py):
     # the leader aggregates every worker's shm stats block so ONE
     # scrape sees the whole front, keyed by the worker's process id

@@ -101,6 +101,21 @@ def make_parser() -> argparse.ArgumentParser:
         "DSS_AUTOTUNE_PROFILE",
     )
     p.add_argument(
+        "--self_tune",
+        action="store_true",
+        default=os.environ.get("DSS_TUNE", "0").lower()
+        in ("1", "true", "yes", "on"),
+        help="arm the self-tuning controller (dss_tpu/tune): fit "
+        "cost-model knobs from the live stage histograms, shadow-"
+        "evaluate every proposal against the recorded decision "
+        "trace, hot-swap accepted knobs through configure_serving, "
+        "and roll back automatically if the guard window's measured "
+        "p99 regresses.  Knob precedence: operator env > boot "
+        "profile > tuner (profile-seeded keys stay tunable, "
+        "explicit env keys are never touched).  DSS_TUNE_* knobs in "
+        "docs/OPERATIONS.md.  Env fallback DSS_TUNE",
+    )
+    p.add_argument(
         "--region_url",
         default="",
         help="region log server URL(s), comma-separated primary + "
@@ -797,6 +812,54 @@ def build(args) -> web.Application:
     # mode) after the listen sockets exist
     app["dss_store"] = store
     app["dss_metrics"] = metrics
+
+    # autotune profile provenance (satellite of the self-tuning loop):
+    # stable gauge whether or not a profile was loaded — 0.0 means
+    # "no profile or no timestamp", the alertable case is large
+    metrics.set_gauge(
+        "dss_autotune_profile_age_s",
+        float(getattr(args, "_autotune_profile_age_s", 0.0)),
+    )
+
+    tune_cfg = None
+    if args.self_tune:
+        from dss_tpu import tune as _tune
+
+        tune_cfg = _tune.env_knobs()
+
+        def _tune_actuator(kn, _store=store):
+            _store.configure_serving(**{
+                _tune.KNOB_TO_CONFIGURE[k]: v for k, v in kn.items()
+            })
+
+        controller = _tune.TuneController(
+            # late-binds the shm whole-front aggregate: main() wires
+            # set_stage_agg after the listen sockets exist
+            hist_provider=metrics.stage_hist_front,
+            actuator=_tune_actuator,
+            current_fn=store.tune_knob_values,
+            interval_s=tune_cfg["interval_s"],
+            guard_s=tune_cfg["guard_s"],
+            min_count=tune_cfg["min_count"],
+            deadband=tune_cfg["deadband"],
+            p99_tol=tune_cfg["p99_tol"],
+            rollback_frac=tune_cfg["rollback_frac"],
+            ring=tune_cfg["ring"],
+            profile_seeded=getattr(
+                args, "_autotune_profile_seeded", ()
+            ),
+        )
+        store.attach_tuner(controller)
+        log.info(
+            "self-tuning armed: interval %.0fs, guard %.0fs, "
+            "min_count %d, deadband %.0f%%, rollback at %.2fx p99 "
+            "(DSS_TUNE_* knobs in OPERATIONS.md; freeze with "
+            "store.tune.freeze() or a DSS_TUNE=0 restart)",
+            tune_cfg["interval_s"], tune_cfg["guard_s"],
+            tune_cfg["min_count"], 100.0 * tune_cfg["deadband"],
+            tune_cfg["rollback_frac"],
+        )
+
     from dss_tpu.obs import trace as _trace
 
     if _trace.enabled():
@@ -926,13 +989,43 @@ def main():
 
         profile = _autotune.load_profile(args.autotune_profile)
         applied = _autotune.apply_profile(profile)
-        get_logger("dss.server").info(
+        _plog = get_logger("dss.server")
+        _plog.info(
             "autotune profile %s (host class %s): seeded %s",
             args.autotune_profile,
             profile.get("host_class", "?"),
             ", ".join(f"{k}={v}" for k, v in sorted(applied.items()))
             or "nothing (env overrides everything)",
         )
+        stale = _autotune.profile_staleness(profile)
+        if not stale["host_class_match"]:
+            _plog.warning(
+                "AUTOTUNE PROFILE HOST-CLASS MISMATCH: profile "
+                "measured on %r, this host is %r — the seeded cost "
+                "models describe a DIFFERENT machine; re-run "
+                "`bench.py --leg autotune` here (or arm --self_tune "
+                "to converge live)",
+                stale["profile_host_class"], stale["host_class"],
+            )
+        if not stale["has_timestamp"]:
+            _plog.warning(
+                "autotune profile %s has no measured_at timestamp "
+                "(pre-provenance format): age unknown, treating as "
+                "fresh; re-run `bench.py --leg autotune` to stamp it",
+                args.autotune_profile,
+            )
+        elif stale["age_s"] > 30 * 86400.0:
+            _plog.warning(
+                "autotune profile %s is %.0f days old: the measured "
+                "cost models may no longer describe this host; "
+                "re-run `bench.py --leg autotune`",
+                args.autotune_profile, stale["age_s"] / 86400.0,
+            )
+        # build() exports age as dss_autotune_profile_age_s and hands
+        # the seeded key set to the tuner (env > profile > tuner:
+        # profile-seeded env keys stay proposable)
+        args._autotune_profile_age_s = stale["age_s"]
+        args._autotune_profile_seeded = tuple(sorted(applied))
 
     from dss_tpu.cmds import make_ssl_context
 

@@ -547,12 +547,44 @@ class QueryCoalescer:
         inline: Optional[bool] = None,
         slo_ms: Optional[float] = None,
         resident: Optional[bool] = None,
+        est_floor_ms: Optional[float] = None,
+        est_item_ms: Optional[float] = None,
+        est_chunk_ms: Optional[float] = None,
+        est_res_floor_ms: Optional[float] = None,
+        est_res_lat_ms: Optional[float] = None,
+        res_ring: Optional[int] = None,
+        res_inflight: Optional[int] = None,
     ) -> None:
-        """Adjust serving knobs at runtime (ops endpoint / tests).
-        Pipeline depth is fixed at construction (the double buffer).
-        resident=True attaches the resident loop (idempotent);
-        resident=False detaches it for NEW batches (the loop drains
-        what it holds — in-flight callers still resolve)."""
+        """Adjust serving knobs at runtime (ops endpoint / tests / the
+        tune actuator).  Pipeline depth is fixed at construction (the
+        double buffer).  resident=True attaches the resident loop
+        (idempotent); resident=False detaches it for NEW batches (the
+        loop drains what it holds — in-flight callers still resolve).
+        The est_* knobs reseed the live CostModel (CostModel.reseed —
+        the tuner's hot-swap path; winsorization would otherwise make
+        a post-flip correction crawl); res_ring/res_inflight resize
+        the resident loop by detach+reattach when one is running
+        (in-flight batches drain first, same contract as resident
+        toggling)."""
+        if (est_floor_ms is not None or est_item_ms is not None
+                or est_chunk_ms is not None
+                or est_res_floor_ms is not None
+                or est_res_lat_ms is not None):
+            self._cost.reseed(
+                floor_ms=est_floor_ms, item_ms=est_item_ms,
+                chunk_ms=est_chunk_ms,
+                res_floor_ms=est_res_floor_ms,
+                res_lat_ms=est_res_lat_ms,
+            )
+        if res_ring is not None or res_inflight is not None:
+            if res_ring is not None:
+                self._res_ring = max(1, int(res_ring))
+            if res_inflight is not None:
+                self._res_inflight = max(1, int(res_inflight))
+            if self._res_loop is not None:
+                loop, self._res_loop = self._res_loop, None
+                loop.close(join=True)
+                self._make_resident_loop()
         if resident is not None:
             if resident:
                 self._make_resident_loop()

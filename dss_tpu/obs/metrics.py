@@ -198,6 +198,32 @@ class MetricsRegistry:
         the exposition (see __init__ note)."""
         self._stage_agg = provider
 
+    def stage_hist_snapshot(self) -> Dict[Tuple[str, str], tuple]:
+        """{(route, stage): (cumulative bucket counts, sum_s, cnt)} —
+        this process's stage histograms, in the SAME shape a whole-front
+        shm aggregate provider returns (parallel/shmring.shm_stage_hist)
+        and the bench /metrics scrape parses, so the tune observer reads
+        any of the three through one seam."""
+        with self._lock:
+            return {
+                k: (tuple(row[:-2]), row[-2], row[-1])
+                for k, row in self._shist.items()
+            }
+
+    def stage_hist_front(self) -> Dict[Tuple[str, str], tuple]:
+        """The widest stage-histogram view this process can see: the
+        whole-front shm aggregate when one is wired (set_stage_agg),
+        else this process's own histograms.  The tune observer's
+        default provider — the tuner fits what the FRONT measured, not
+        just the owner process."""
+        agg = self._stage_agg
+        if agg is not None:
+            try:
+                return agg() or {}
+            except Exception:  # noqa: BLE001 — fall back to local
+                pass
+        return self.stage_hist_snapshot()
+
     def set_gauge(self, name: str, value: float) -> None:
         with self._lock:
             self._gauges[name] = float(value)
@@ -368,3 +394,56 @@ class MetricsRegistry:
                         l = lab(l)
                     lines.append(f"{name}{{{l}}} {v}")
         return "\n".join(lines) + "\n"
+
+
+# -- stage-histogram window math (the tune observer's inputs) -----------------
+
+
+def stage_hist_delta(h0: dict, h1: dict) -> dict:
+    """Per-key difference of two stage-histogram snapshots (h1 - h0):
+    what was observed INSIDE the window between them.  Keys that first
+    appear in h1 count from zero; negative deltas (a restarted worker's
+    shm block, a reset registry) clamp to zero rather than poisoning a
+    fit; keys with no new observations are dropped."""
+    out = {}
+    for k, (c1, s1, n1) in h1.items():
+        c0, s0, n0 = h0.get(k, ((0,) * len(c1), 0.0, 0))
+        dn = max(0, int(n1) - int(n0))
+        if dn <= 0:
+            continue
+        dc = tuple(
+            max(0, int(a) - int(b)) for a, b in zip(c1, c0)
+        )
+        out[k] = (dc, max(0.0, float(s1) - float(s0)), dn)
+    return out
+
+
+def stage_hist_quantile(counts, cnt, q: float,
+                        buckets=STAGE_BUCKETS):
+    """Linear-interpolated quantile (seconds) of one histogram row:
+    cumulative bucket counts + total count -> the q-quantile
+    interpolated inside the breached bucket.  THE shared interpolation:
+    bench.py's stage-attribution table and the tune observer's
+    cost-model fitter both call this, so a fitted floor can never
+    disagree with the p99 the operator reads in the bench report.
+
+    Edge cases are policy, not accidents: an empty histogram returns
+    None (nothing to claim), a tail living past the last bucket returns
+    the last edge as a FLOOR (the histogram cannot resolve further — a
+    number beyond it would be invented), and a single occupied bucket
+    interpolates from the previous edge exactly like any other."""
+    cnt = float(cnt)
+    if cnt <= 0:
+        return None
+    target = max(0.0, min(1.0, float(q))) * cnt
+    prev_edge, prev_cum = 0.0, 0.0
+    for i, edge in enumerate(buckets[: len(counts)]):
+        cum = float(counts[i])
+        if cum >= target:
+            span_n = cum - prev_cum
+            frac = (target - prev_cum) / span_n if span_n > 0 else 1.0
+            return prev_edge + frac * (edge - prev_edge)
+        prev_edge, prev_cum = edge, cum
+    # the tail lives past the last bucket: report its edge as the
+    # floor rather than inventing a number
+    return float(buckets[len(counts) - 1] if counts else 0.0)

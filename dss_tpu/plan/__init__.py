@@ -48,6 +48,7 @@ from dss_tpu.plan.planner import (
     Planner,
     decide,
     plan_drain_cap,
+    set_decision_hook,
 )
 
 __all__ = [
@@ -60,4 +61,5 @@ __all__ = [
     "ROUTES",
     "decide",
     "plan_drain_cap",
+    "set_decision_hook",
 ]

@@ -623,6 +623,10 @@ def autotune(*, quick: bool = False, entities: Optional[int] = None,
     return {
         "format": PROFILE_FORMAT,
         "host_class": host_class(),
+        # wall-clock provenance: boot warns when a profile is stale or
+        # from another host class, and exports the age as the
+        # dss_autotune_profile_age_s gauge (DssAutotuneStale material)
+        "measured_at": time.time(),
         "quick": bool(quick),
         "fixture": {"entities": n_ent, "cells": n_cel},
         "sweep_s": round(time.perf_counter() - t_all, 2),
@@ -682,6 +686,32 @@ def load_profile(path: str) -> dict:
             f"({PROFILE_FORMAT})"
         )
     return profile
+
+
+def profile_staleness(profile: dict, *,
+                      now: Optional[float] = None) -> dict:
+    """How much to trust a loaded profile: its age in seconds (0.0
+    for pre-provenance profiles that never recorded measured_at — age
+    unknown, treated as fresh rather than infinitely stale so old
+    profiles keep booting) and whether it was measured on THIS host
+    class.  The server logs a loud warning on either mismatch and
+    exports the age as dss_autotune_profile_age_s."""
+    now = time.time() if now is None else float(now)
+    measured_at = profile.get("measured_at")
+    age_s = 0.0
+    if measured_at is not None:
+        try:
+            age_s = max(0.0, now - float(measured_at))
+        except (TypeError, ValueError):
+            age_s = 0.0
+    prof_hc = str(profile.get("host_class", ""))
+    return {
+        "age_s": age_s,
+        "has_timestamp": measured_at is not None,
+        "profile_host_class": prof_hc,
+        "host_class": host_class(),
+        "host_class_match": (not prof_hc) or prof_hc == host_class(),
+    }
 
 
 def apply_profile(profile: dict, env=None) -> Dict[str, str]:

@@ -207,6 +207,46 @@ class CostModel:
     def _chunks(self, n: int) -> int:
         return chunks_of(n, self.chunk)
 
+    def reseed(self, *, floor_ms: Optional[float] = None,
+               item_ms: Optional[float] = None,
+               chunk_ms: Optional[float] = None,
+               res_floor_ms: Optional[float] = None,
+               res_lat_ms: Optional[float] = None,
+               rq_floor_ms: Optional[float] = None,
+               rq_item_ms: Optional[float] = None) -> None:
+        """Jump estimates to externally MEASURED values — the tune
+        actuator's hot-swap seam (dss_tpu/tune).  Unlike observe_*,
+        which winsorizes each sample to 4x the current prediction (a
+        genuine workload flip therefore converges only as fast as the
+        clamp ratchets), a reseed lands in one step: the tuner fitted
+        the new value from an unclamped whole-front histogram window,
+        so the usual single-outlier defense does not apply.  When the
+        cold-device pair changes, the EWMA moments are re-primed from
+        the new seed (exactly as __init__ does) so subsequent
+        observations BLEND forward from it instead of snapping the fit
+        back to the pre-swap line.  None leaves a key untouched."""
+        if floor_ms is not None:
+            self.est_floor_ms = max(0.05, float(floor_ms))
+        if item_ms is not None:
+            self.est_item_ms = max(0.0, float(item_ms))
+        if chunk_ms is not None:
+            self.est_chunk_ms = max(1e-3, float(chunk_ms))
+        if res_floor_ms is not None:
+            self.est_res_floor_ms = max(0.02, float(res_floor_ms))
+        if res_lat_ms is not None:
+            self.est_res_lat_ms = max(0.02, float(res_lat_ms))
+        if rq_floor_ms is not None:
+            self.est_rq_floor_ms = max(0.02, float(rq_floor_ms))
+        if rq_item_ms is not None:
+            self.est_rq_item_ms = max(0.0, float(rq_item_ms))
+        if floor_ms is not None or item_ms is not None:
+            n0 = float(4 * self.chunk)
+            t0 = self.est_floor_ms + self.est_item_ms * n0
+            self._sn = n0
+            self._st = t0
+            self._snn = n0 * n0
+            self._snt = n0 * t0
+
     def observe_device(self, n: int, total_ms: float) -> None:
         a = self.alpha
         n = float(max(1, n))

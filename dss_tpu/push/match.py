@@ -49,10 +49,17 @@ class MatchStage:
     admissible, so every plan routes hostchunk — the oracle."""
 
     def __init__(self, index, *, planner: Optional[Planner] = None,
-                 health=None):
+                 health=None, metrics=None):
         self._index = index
         self._table = getattr(index, "table", None)
         self._health = health
+        # direct registry handle: match runs on writer/pipeline
+        # threads with no thread-local stage sink, so stages.mark alone
+        # would drop push_match_ms on the floor — this feeds the
+        # dss_stage_duration_seconds{stage="push_match_ms"} histogram
+        # (STAGE_NAMES allowlist) the same way deliver.py feeds
+        # push_deliver_ms
+        self._metrics = metrics
         co = getattr(index, "coalescer", None)
         if planner is not None:
             self._planner = planner
@@ -161,6 +168,10 @@ class MatchStage:
         if plan.route == "rqmatch":
             self._planner.observe_rqmatch(b, dur_ms)
         stages.mark("push_match_ms", dur_ms)
+        if self._metrics is not None:
+            self._metrics.observe_stage(
+                "push", "push_match_ms", dur_ms / 1000.0
+            )
         self.batches += 1
         self.queries += b
         return out

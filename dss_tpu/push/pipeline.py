@@ -99,6 +99,7 @@ class PushPipeline:
             on_edge=self._update_health,
         )
         self._transport = transport
+        self._metrics = metrics
         self._federate = bool(federate)
         self._depth_high = float(depth_high)
         self._depth_low = float(depth_low)
@@ -122,10 +123,12 @@ class PushPipeline:
         self._health = store.health
         self._stages = {
             "rid_sub": MatchStage(
-                store.rid._sub_index, health=store.health
+                store.rid._sub_index, health=store.health,
+                metrics=self._metrics,
             ),
             "scd_sub": MatchStage(
-                store.scd._sub_index, health=store.health
+                store.scd._sub_index, health=store.health,
+                metrics=self._metrics,
             ),
         }
         self.pool.start()

@@ -83,6 +83,9 @@ def _exported_metric_names() -> set:
     # tpu-storage DAR gauges (memory backend exports fewer)
     tpu = DSSStore(storage="tpu", clock=Clock())
     names |= set(tpu.stats())
+    # set directly on the registry by cmds/server.py build() (not a
+    # store stats key): boot-profile staleness
+    names.add("dss_autotune_profile_age_s")
     return names
 
 
@@ -816,6 +819,73 @@ def test_grafana_and_rules_cover_tracing():
     assert (
         "dss_stage_duration_seconds_bucket"
         in alerts["DssStageLatencyRegression"]
+    )
+
+
+def test_grafana_and_rules_cover_tuner():
+    """The self-tuning loop must stay observable: a knob panel showing
+    active vs last-proposed values (plus boot-profile age), a flow
+    panel over the proposal/apply/rollback counters and guard-window
+    p99, and the DssTuneRollback warn alert on the rollback counter."""
+    dash = json.load(
+        open(os.path.join(ROOT, "deploy/grafana/dss-dashboard.json"))
+    )
+    exprs = [
+        t["expr"]
+        for p in dash["panels"]
+        for t in p.get("targets", [])
+    ]
+    for needed in (
+        "dss_tune_knob_active",
+        "dss_tune_knob_proposed",
+        "dss_tune_proposals_total",
+        "dss_tune_applied_total",
+        "dss_tune_shadow_rejected_total",
+        "dss_tune_rollbacks_total",
+        "dss_tune_apply_failed_total",
+        "dss_tune_guard_p99_ms",
+        "dss_autotune_profile_age_s",
+    ):
+        assert any(needed in e for e in exprs), needed
+    rules = yaml.safe_load(
+        open(os.path.join(ROOT, "deploy/prometheus/rules.yaml"))
+    )
+    alerts = {
+        r.get("alert"): r
+        for g in rules["groups"]
+        for r in g["rules"]
+    }
+    assert "DssTuneRollback" in alerts
+    assert "dss_tune_rollbacks_total" in alerts["DssTuneRollback"]["expr"]
+    assert alerts["DssTuneRollback"]["labels"]["severity"] == "warn"
+
+
+def test_tune_gauges_render_as_labeled_families():
+    """dss_tune_knob_active / dss_tune_knob_proposed are dict-valued
+    stats keys: the metrics handler's per-metric label map explodes
+    them into gauge families labeled by knob name, and a tunerless
+    store must still export the whole scalar dss_tune_* surface
+    (series never appear only once someone flips DSS_TUNE=1)."""
+    from dss_tpu.api.app import _GAUGE_VEC_LABELS
+    from dss_tpu.clock import Clock
+    from dss_tpu.dar.dss_store import DSSStore
+    from dss_tpu.obs.metrics import MetricsRegistry
+
+    assert _GAUGE_VEC_LABELS["dss_tune_knob_active"] == "knob"
+    assert _GAUGE_VEC_LABELS["dss_tune_knob_proposed"] == "knob"
+    store = DSSStore(storage="memory", clock=Clock())
+    stats = store.stats()
+    assert stats["dss_tune_enabled"] == 0
+    assert stats["dss_tune_rollbacks_total"] == 0
+    assert stats["dss_tune_knob_active"] == {}
+    reg = MetricsRegistry()
+    reg.set_gauge_vec(
+        "dss_tune_knob_active", "knob",
+        {"DSS_CO_EST_FLOOR_MS": 2.5},
+    )
+    text = reg.render()
+    assert (
+        'dss_tune_knob_active{knob="DSS_CO_EST_FLOOR_MS"} 2.5' in text
     )
 
 

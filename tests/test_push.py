@@ -478,6 +478,36 @@ def test_match_fault_absorbed_onto_oracle():
     store.close()
 
 
+def test_match_feeds_stage_duration_histogram():
+    """push_match_ms rides the bounded dss_stage_duration_seconds
+    histogram (route class "push") when the stage is given a registry
+    handle — match runs on writer/pipeline threads with no
+    thread-local stage sink, so the direct observe_stage call is the
+    only way the tuner/attribution ever sees it."""
+    from dss_tpu.obs.metrics import MetricsRegistry
+
+    store, clock = _seeded_store("memory")
+    reg = MetricsRegistry()
+    stage = MatchStage(
+        store.scd._sub_index, health=store.health, metrics=reg
+    )
+    now_ns = int(T0.timestamp() * 1e9)
+    stage.match_many(
+        [(CELLS_A, None, None, None, None)] * 3, now_ns=now_ns
+    )
+    snap = reg.stage_hist_snapshot()
+    assert ("push", "push_match_ms") in snap
+    counts, sum_s, cnt = snap[("push", "push_match_ms")]
+    assert cnt == 1  # one batch, one sample
+    assert sum_s > 0.0
+    # without the handle: no histogram row, and nothing raises
+    silent = MatchStage(store.scd._sub_index, health=store.health)
+    silent.match_many(
+        [(CELLS_A, None, None, None, None)], now_ns=now_ns
+    )
+    store.close()
+
+
 @pytest.mark.parametrize("storage", ["memory", "tpu"])
 def test_write_path_responses_unchanged_by_push(storage):
     """Satellite 3's contract: attaching the pipeline must not change
